@@ -1,0 +1,48 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Ray-equivalent capabilities (tasks, actors, objects, placement groups, Data /
+Train / Tune / Serve / RL libraries) designed TPU-first: the device plane is
+JAX/XLA (meshes, pjit, Pallas kernels, ICI collectives); the host plane is a
+native runtime scheduling processes across TPU hosts.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.actor import method
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.config import _config
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "exceptions",
+]

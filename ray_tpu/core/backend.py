@@ -1,0 +1,95 @@
+"""Backend interface: the seam between the public API and a runtime.
+
+Two implementations:
+- ``LocalBackend`` (local_backend.py): in-process, thread-based — the analog of the
+  reference's LOCAL_MODE (python/ray/_private/worker.py mode handling). Used for
+  unit tests and quick iteration.
+- ``ClusterBackend`` (cluster_backend.py): the real multi-process runtime (GCS +
+  raylets + workers + shared-memory object store), analog of SCRIPT_MODE driving
+  the native core.
+"""
+
+from __future__ import annotations
+
+import abc
+import concurrent.futures
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.options import RemoteOptions
+from ray_tpu.core.refs import ObjectRef
+
+
+class Backend(abc.ABC):
+    @abc.abstractmethod
+    def submit_task(
+        self, func, args: tuple, kwargs: dict, options: RemoteOptions
+    ) -> Sequence[ObjectRef]:
+        """Submit a stateless task; returns one ref per return value."""
+
+    @abc.abstractmethod
+    def create_actor(
+        self, cls, args: tuple, kwargs: dict, options: RemoteOptions
+    ) -> ActorID:
+        ...
+
+    @abc.abstractmethod
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        options: RemoteOptions,
+    ) -> Sequence[ObjectRef]:
+        ...
+
+    @abc.abstractmethod
+    def put(self, value: Any) -> ObjectRef:
+        ...
+
+    @abc.abstractmethod
+    def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        ...
+
+    @abc.abstractmethod
+    def wait(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int,
+        timeout: Optional[float],
+        fetch_local: bool,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ...
+
+    @abc.abstractmethod
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        ...
+
+    @abc.abstractmethod
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        ...
+
+    @abc.abstractmethod
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
+        ...
+
+    @abc.abstractmethod
+    def shutdown(self) -> None:
+        ...
+
+    # --- optional capabilities (cluster backend overrides) -------------------
+    def get_named_actor(self, name: str, namespace: Optional[str]) -> ActorID:
+        raise ValueError(f"Failed to look up actor '{name}'")
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return {}
+
+    def available_resources(self) -> Dict[str, float]:
+        return {}
+
+    def nodes(self) -> List[dict]:
+        return []
+
+    def free_actor(self, actor_id: ActorID) -> None:
+        """Called when the last local ActorHandle is GC'd (out-of-scope kill)."""

@@ -1,0 +1,15 @@
+"""Cluster backend: driver side of the real multi-process runtime.
+
+Milestone 3 (SURVEY.md §7 phases 1-2) replaces this stub with the full
+GCS + raylet + worker + shared-memory object-store runtime.
+"""
+
+from __future__ import annotations
+
+
+class ClusterBackend:
+    def __init__(self, **kwargs):
+        raise NotImplementedError(
+            "ray_tpu cluster mode is not built yet in this checkout; "
+            "use ray_tpu.init(local_mode=True) meanwhile"
+        )

@@ -1,0 +1,86 @@
+"""Runtime configuration flags.
+
+Parity: the reference has a single flag registry (src/ray/common/ray_config_def.h,
+205 RAY_CONFIG entries loaded from RAY_<name> env vars). Same pattern here: every
+tunable lives in this table, overridable via ``RAY_TPU_<NAME>`` environment
+variables, readable as ``ray_tpu._config.<name>``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+
+def _env(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if t is int:
+        return int(raw)
+    if t is float:
+        return float(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # --- scheduling ---------------------------------------------------------
+    # Hybrid scheduling: prefer local node until its utilization crosses this
+    # threshold, then pack remote nodes (cold-start vs bin-packing tradeoff,
+    # mirrors raylet/scheduling/policy/hybrid_scheduling_policy.h).
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    max_pending_lease_requests_per_scheduling_key: int = 10
+    worker_lease_timeout_ms: int = 10_000
+
+    # --- object store -------------------------------------------------------
+    object_store_memory_mb: int = 2048
+    # objects smaller than this are returned in-band to the owner's memory
+    # store instead of the shared-memory store (direct returns).
+    max_direct_call_object_size: int = 100 * 1024
+    object_spilling_dir: str = ""
+    object_store_full_delay_ms: int = 100
+
+    # --- timeouts / health --------------------------------------------------
+    health_check_period_ms: int = 1_000
+    health_check_failure_threshold: int = 5
+    gcs_rpc_timeout_s: float = 30.0
+    actor_restart_backoff_s: float = 0.5
+
+    # --- workers ------------------------------------------------------------
+    num_workers_soft_limit: int = 0  # 0 = num_cpus
+    worker_startup_timeout_s: float = 30.0
+    enable_worker_prestart: bool = True
+    idle_worker_killing_time_ms: int = 300_000
+
+    # --- retries ------------------------------------------------------------
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+
+    # --- logging / events ---------------------------------------------------
+    log_to_driver: bool = True
+    task_events_buffer_size: int = 10_000
+    metrics_report_interval_ms: int = 2_000
+
+    def __post_init__(self):
+        for f in fields(self):
+            object.__setattr__(self, f.name, _env(f.name, getattr(self, f.name)))
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @staticmethod
+    def from_json(s: str) -> "Config":
+        cfg = Config()
+        for k, v in json.loads(s).items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+_config = Config()

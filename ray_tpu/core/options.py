@@ -1,0 +1,74 @@
+"""Task/actor option validation and defaults.
+
+Parity: python/ray/_private/ray_option_utils.py:211 centralizes option plumbing in
+the reference. Same role here; a single dataclass feeds both the `@remote` decorator
+and the per-call ``.options(...)`` override path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class RemoteOptions:
+    num_cpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    memory: Optional[float] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    num_returns: int = 1
+    max_retries: Optional[int] = None          # tasks
+    retry_exceptions: bool = False
+    max_restarts: int = 0                      # actors
+    max_task_retries: int = 0                  # actor tasks
+    max_concurrency: int = 1                   # actor concurrency
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    name: Optional[str] = None                 # named actors
+    namespace: Optional[str] = None
+    get_if_exists: bool = False
+    lifetime: Optional[str] = None             # None | "detached"
+    scheduling_strategy: Any = None            # str | NodeAffinity… | PlacementGroup…
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    runtime_env: Optional[Dict[str, Any]] = None
+    accelerator_type: Optional[str] = None     # e.g. "TPU-v5litepod"
+    _metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def merged_with(self, **overrides) -> "RemoteOptions":
+        clean = {k: v for k, v in overrides.items() if v is not None or k in ("name",)}
+        return replace(self, **clean)
+
+    def task_resources(self, is_actor: bool = False) -> Dict[str, float]:
+        res = dict(self.resources)
+        if self.num_cpus is not None:
+            res["CPU"] = float(self.num_cpus)
+        else:
+            # Tasks default to 1 CPU; actor *methods* are cheap (the actor holds
+            # its resources for its lifetime), matching reference defaults.
+            res["CPU"] = 0.0 if is_actor else 1.0
+        if self.num_tpus:
+            res["TPU"] = float(self.num_tpus)
+        if self.memory:
+            res["memory"] = float(self.memory)
+        if self.accelerator_type:
+            res[self.accelerator_type] = 0.001
+        return {k: v for k, v in res.items() if v}
+
+
+def options_from_kwargs(is_actor: bool, **kwargs) -> RemoteOptions:
+    valid = set(RemoteOptions.__dataclass_fields__)
+    # accept reference-compatible aliases
+    if "num_gpus" in kwargs:
+        raise ValueError(
+            "ray_tpu is a TPU-native framework: use num_tpus instead of num_gpus"
+        )
+    unknown = set(kwargs) - valid
+    if unknown:
+        raise ValueError(f"Unknown remote options: {sorted(unknown)}")
+    opts = RemoteOptions(**kwargs)
+    if opts.num_returns < 0:
+        raise ValueError("num_returns must be >= 0")
+    if not is_actor and (opts.max_restarts or opts.max_task_retries):
+        raise ValueError("max_restarts/max_task_retries are actor-only options")
+    return opts

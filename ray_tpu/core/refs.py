@@ -1,0 +1,68 @@
+"""ObjectRef — a future/handle to an immutable object in the cluster.
+
+Parity: the reference's ``ObjectRef`` (python/ray/includes/object_ref.pxi) is a thin
+wrapper over a binary id plus the owner's address; `ray.get` resolves it through the
+owner. Ours carries the ObjectID and the owner's (node, worker) addresses so any
+process can resolve it without a central directory — the *owner* serves locations
+(ownership model of src/ray/core_worker/reference_count.h:61).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID, TaskID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "task_id", "_in_band_value", "_has_in_band")
+
+    def __init__(
+        self,
+        object_id: ObjectID,
+        owner_addr: Optional[str] = None,
+        task_id: Optional[TaskID] = None,
+    ):
+        self.id = object_id
+        self.owner_addr = owner_addr  # "host:port" of owning worker's RPC endpoint
+        self.task_id = task_id  # creating task (for lineage reconstruction)
+        self._in_band_value = None
+        self._has_in_band = False
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # in-band value deliberately not pickled: receivers resolve via the owner.
+        return (_rebuild_ref, (self.id, self.owner_addr, self.task_id))
+
+    # -- convenience -------------------------------------------------------
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the object value."""
+        from ray_tpu.api import _global_worker
+
+        return _global_worker().backend.as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        from ray_tpu.api import _global_worker
+
+        backend = _global_worker().backend
+        return asyncio.wrap_future(backend.as_future(self)).__await__()
+
+
+def _rebuild_ref(object_id, owner_addr, task_id):
+    return ObjectRef(object_id, owner_addr, task_id)

@@ -1,0 +1,100 @@
+"""Lazy DAG composition nodes (reference: python/ray/dag/ — dag_node.py,
+function_node.py, class_node.py, input_node.py). Used by Serve deployment graphs
+and Workflow.
+
+A DAG node records a computation without executing it; ``.execute()`` walks the
+graph submitting tasks/actors through the normal API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class DAGNode:
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _resolve(self, value, input_value):
+        if isinstance(value, DAGNode):
+            return value.execute(input_value)
+        if isinstance(value, InputNode):
+            return input_value
+        return value
+
+    def _resolved_args(self, input_value):
+        args = [self._resolve(a, input_value) for a in self._bound_args]
+        kwargs = {k: self._resolve(v, input_value) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def execute(self, input_value: Any = None):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the DAG's runtime input."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def execute(self, input_value=None):
+        return input_value
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def execute(self, input_value=None):
+        import ray_tpu
+
+        args, kwargs = self._resolved_args(input_value)
+        # resolve upstream refs so values flow through the graph
+        args = [ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a for a in args]
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = actor_cls
+        self._handle = None
+
+    def execute(self, input_value=None):
+        if self._handle is None:
+            args, kwargs = self._resolved_args(input_value)
+            self._handle = self._cls.remote(*args, **kwargs)
+        return self._handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClassMethodNode(self, name)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method_name: str):
+        super().__init__((), {})
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        return self
+
+    def execute(self, input_value=None):
+        import ray_tpu
+
+        handle = self._class_node.execute(input_value)
+        args, kwargs = self._resolved_args(input_value)
+        args = [ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a for a in args]
+        return getattr(handle, self._method_name).remote(*args, **kwargs)

@@ -1,0 +1,95 @@
+"""Exception hierarchy.
+
+Parity: python/ray/exceptions.py — RayTaskError wraps the remote traceback and is
+re-raised at `get`; actor/object/worker failures get dedicated types so user code
+can react (retry, restore from checkpoint, …).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get()."""
+
+    def __init__(self, cause_cls_name: str, traceback_str: str, cause=None):
+        self.cause_cls_name = cause_cls_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"task failed with {cause_cls_name}\n"
+            f"--- remote traceback ---\n{traceback_str}"
+        )
+
+    @staticmethod
+    def from_exception(e: BaseException) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(e), e, e.__traceback__))
+        # keep the original exception when picklable so `except UserError` works
+        try:
+            import cloudpickle
+
+            cloudpickle.dumps(e)
+            cause = e
+        except Exception:
+            cause = None
+        return TaskError(type(e).__name__, tb, cause)
+
+    def as_instanceof_cause(self):
+        return self.cause if self.cause is not None else self
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died (OOM-kill, segfault, chaos test…)."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        super().__init__(f"actor {actor_id} died: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """Actor is restarting; the call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object data was lost (node death / eviction) and could not be
+    reconstructed from lineage."""
+
+    def __init__(self, object_id=None, reason: str = ""):
+        self.object_id = object_id
+        super().__init__(f"object {object_id} lost: {reason}")
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass

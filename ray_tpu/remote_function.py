@@ -1,0 +1,59 @@
+"""@ray_tpu.remote for functions.
+
+Parity: python/ray/remote_function.py:245 (`RemoteFunction._remote`) — options
+merging, num_returns handling, submission through the active backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu.core.options import RemoteOptions, options_from_kwargs
+
+
+class RemoteFunction:
+    def __init__(self, func, options: RemoteOptions):
+        self._function = func
+        self._default_options = options
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{getattr(self._function, '__name__', '?')}' cannot be "
+            "called directly; use .remote()"
+        )
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        merged = self._default_options.merged_with(**kwargs)
+        return RemoteFunction(self._function, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_options)
+
+    def _remote(self, args, kwargs, options: RemoteOptions):
+        from ray_tpu.api import _auto_init, _global_worker
+
+        _auto_init()
+        backend = _global_worker().backend
+        refs = backend.submit_task(self._function, args, kwargs, options)
+        if options.num_returns == 1:
+            return refs[0]
+        if options.num_returns == 0:
+            return None
+        return list(refs)
+
+    @property
+    def bound(self):
+        """For DAG composition (serve deployment graphs)."""
+        from ray_tpu.dag import FunctionNode
+
+        def bind(*args, **kwargs):
+            return FunctionNode(self, args, kwargs)
+
+        return bind
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)

@@ -1,0 +1,50 @@
+"""Test fixtures.
+
+JAX tests run on a virtual 8-device CPU mesh (the reference tests multi-GPU code
+paths on CPU via `_fake_gpus`; we use XLA's host-platform device-count flag, see
+SURVEY.md §4). Must be set before jax import — hence module-level os.environ here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_local():
+    """In-process (local mode) runtime — fast unit-test fixture."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Real single-node cluster: GCS + raylet + workers in subprocesses
+    (reference analog: python/ray/tests/conftest.py:351 ray_start_regular)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    """An 8-device CPU mesh standing in for a TPU slice."""
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest must force 8 host devices"
+    yield devices[:8]

@@ -1,0 +1,86 @@
+"""Actor death / kill semantics (reference analog: test_actor_failures.py)."""
+
+import time
+
+import pytest
+
+
+def test_kill_resolves_pending_refs(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Slow:
+        def nap(self):
+            time.sleep(30)
+            return "done"
+
+    a = Slow.remote()
+    ref = a.nap.remote()
+    queued = a.nap.remote()  # sits in the queue behind the in-flight call
+    time.sleep(0.1)
+    ray.kill(a)
+    with pytest.raises(ray.exceptions.ActorDiedError):
+        ray.get(queued, timeout=5)
+
+
+def test_call_after_kill_raises(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray.get(a.ping.remote()) == "pong"
+    ray.kill(a)
+    with pytest.raises(ray.exceptions.ActorDiedError):
+        ray.get(a.ping.remote(), timeout=5)
+
+
+def test_name_released_after_kill(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class N:
+        def who(self):
+            return 1
+
+    h1 = N.options(name="reusable").remote()
+    ray.kill(h1)
+    h2 = N.options(name="reusable").remote()  # must not raise "name taken"
+    assert ray.get(h2.who.remote()) == 1
+
+
+def test_method_num_returns(ray_start_local):
+    ray = ray_start_local
+    from ray_tpu import method
+
+    @ray.remote
+    class M:
+        @method(num_returns=2)
+        def two(self):
+            return "a", "b"
+
+    m = M.remote()
+    r1, r2 = m.two.remote()
+    assert ray.get([r1, r2]) == ["a", "b"]
+
+
+def test_handle_pickles_with_method_metadata(ray_start_local):
+    ray = ray_start_local
+    from ray_tpu import method
+
+    @ray.remote
+    class M:
+        @method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    @ray.remote
+    def use(h):
+        a, b = h.two.remote()
+        return ray.get([a, b])
+
+    m = M.remote()
+    assert ray.get(use.remote(m)) == [1, 2]

@@ -1,0 +1,219 @@
+"""Core API semantics in local mode: tasks, actors, objects, errors.
+
+Mirrors the reference's basic API tests (python/ray/tests/test_basic.py et al.).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_task_roundtrip(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    assert ray.get(add.remote(1, 2)) == 3
+
+
+def test_task_chaining_and_ref_args(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    ref = double.remote(double.remote(double.remote(1)))
+    assert ray.get(ref) == 8
+
+
+def test_put_get_numpy_roundtrip(ray_start_local):
+    ray = ray_start_local
+    arr = np.arange(100_000, dtype=np.float32).reshape(1000, 100)
+    ref = ray.put(arr)
+    out = ray.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_num_returns(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_options_override(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def pair():
+        return "x", "y"
+
+    refs = pair.options(num_returns=2).remote()
+    assert ray.get(refs) == ["x", "y"]
+
+
+def test_task_error_propagates(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(ValueError, match="kapow"):
+        ray.get(boom.remote())
+
+
+def test_wait(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def fast():
+        return "fast"
+
+    @ray.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f] and not_ready == [s]
+
+
+def test_get_timeout(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(ray.exceptions.GetTimeoutError):
+        ray.get(hang.remote(), timeout=0.2)
+
+
+def test_actor_basics(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    refs = [c.incr.remote() for _ in range(5)]
+    assert ray.get(refs) == [11, 12, 13, 14, 15]  # ordered execution
+    assert ray.get(c.value.remote()) == 15
+
+
+def test_actor_handle_passing(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Holder:
+        def __init__(self):
+            self.v = 7
+
+        def value(self):
+            return self.v
+
+    @ray.remote
+    def reader(h):
+        return ray.get(h.value.remote())
+
+    h = Holder.remote()
+    assert ray.get(reader.remote(h)) == 7
+
+
+def test_named_actor(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    creator_handle = Svc.options(name="svc").remote()  # keep alive (non-detached)
+    h = ray.get_actor("svc")
+    assert ray.get(h.ping.remote()) == "pong"
+
+
+def test_actor_error(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor oops")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor oops"):
+        ray.get(b.fail.remote())
+
+
+def test_nested_tasks(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def leaf(x):
+        return x * x
+
+    @ray.remote
+    def parent(n):
+        return sum(ray.get([leaf.remote(i) for i in range(n)]))
+
+    assert ray.get(parent.remote(4)) == 0 + 1 + 4 + 9
+
+
+def test_retry_exceptions(ray_start_local):
+    ray = ray_start_local
+    state = {"n": 0}
+
+    @ray.remote(retry_exceptions=True, max_retries=3)
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+        return state["n"]
+
+    assert ray.get(flaky.remote()) == 3
+
+
+def test_serialization_oob_buffers():
+    from ray_tpu.core.serialization import dumps, loads
+
+    arr = np.random.rand(512, 512)
+    data = dumps({"a": arr, "b": [1, "x"]})
+    out = loads(data)
+    np.testing.assert_array_equal(out["a"], arr)
+    assert out["b"] == [1, "x"]
+
+
+def test_resource_set_arithmetic():
+    from ray_tpu.core.resources import ResourceSet
+
+    total = ResourceSet({"CPU": 4, "TPU": 8})
+    demand = ResourceSet({"CPU": 1, "TPU": 2})
+    assert total.fits(demand)
+    rem = total.subtract(demand)
+    assert rem.get("CPU") == 3 and rem.get("TPU") == 6
+    assert not ResourceSet({"CPU": 0.5}).fits(ResourceSet({"CPU": 1}))
+    # fixed-point: no float drift for fractional cpus
+    r = ResourceSet({"CPU": 4})
+    for _ in range(40):
+        r = r.subtract(ResourceSet({"CPU": 0.1}))
+    assert r.get("CPU") == 0.0
