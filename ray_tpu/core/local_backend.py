@@ -21,7 +21,7 @@ from ray_tpu.core.refs import ObjectRef
 
 
 class _LocalActor:
-    def __init__(self, actor_id: ActorID, cls, args, kwargs, options: RemoteOptions):
+    def __init__(self, actor_id: ActorID, options: RemoteOptions):
         self.actor_id = actor_id
         self.options = options
         self.dead = False
@@ -35,10 +35,22 @@ class _LocalActor:
             max_workers=n, thread_name_prefix=f"actor-{actor_id.hex()[:8]}"
         )
         self.instance = None
-        self._init_future = self._pool.submit(self._construct, cls, args, kwargs)
+        self._init_future = None
 
-    def _construct(self, cls, args, kwargs):
-        self.instance = cls(*args, **kwargs)
+    def start(self, cls, args, kwargs, resolve_args, on_failure):
+        self._init_future = self._pool.submit(
+            self._construct, cls, args, kwargs, resolve_args, on_failure
+        )
+
+    def _construct(self, cls, args, kwargs, resolve_args, on_failure):
+        try:
+            rargs, rkwargs = resolve_args(args, kwargs)
+            self.instance = cls(*rargs, **rkwargs)
+        except BaseException as e:  # noqa: BLE001 - surfaced via init future
+            self.dead = True
+            self.death_reason = f"__init__ failed: {e!r}"
+            on_failure(self)
+            raise
 
     def submit(self, fn, *args):
         return self._pool.submit(fn, *args)
@@ -82,6 +94,15 @@ class LocalBackend(Backend):
         }
         return rargs, rkwargs
 
+    def _set_value(self, ref, value):
+        """Idempotent store: first writer wins (a killed actor may have already
+        resolved the ref with ActorDiedError)."""
+        fut = self._future_for(ref.id)
+        try:
+            fut.set_result(value)
+        except concurrent.futures.InvalidStateError:
+            pass
+
     def _store_results(self, refs, result, num_returns):
         if num_returns == 1:
             results = [result]
@@ -95,15 +116,15 @@ class LocalBackend(Backend):
                     )
                 )
                 for r in refs:
-                    self._future_for(r.id).set_result(err)
+                    self._set_value(r, err)
                 return
         for r, v in zip(refs, results):
-            self._future_for(r.id).set_result(v)
+            self._set_value(r, v)
 
     def _store_error(self, refs, e: BaseException):
         err = exc.TaskError.from_exception(e)
         for r in refs:
-            self._future_for(r.id).set_result(err)
+            self._set_value(r, err)
 
     # ------------------------------------------------------------------ tasks
     def submit_task(self, func, args, kwargs, options: RemoteOptions):
@@ -150,8 +171,19 @@ class LocalBackend(Backend):
                         return self._named_actors[key]
                     raise ValueError(f"actor name '{options.name}' already taken")
                 self._named_actors[key] = actor_id
-        rargs, rkwargs = self._resolve_args(args, kwargs)
-        self._actors[actor_id] = _LocalActor(actor_id, cls, rargs, rkwargs, options)
+
+        def on_init_failure(actor):
+            # failed construction releases the name for reuse
+            with self._lock:
+                for k, aid in list(self._named_actors.items()):
+                    if aid == actor_id:
+                        del self._named_actors[k]
+
+        actor = _LocalActor(actor_id, options)
+        self._actors[actor_id] = actor
+        # async creation: dependency resolution + __init__ run on the actor's
+        # own thread (the driver must not block in .remote())
+        actor.start(cls, args, kwargs, self._resolve_args, on_init_failure)
         return actor_id
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, options):

@@ -36,8 +36,12 @@ class RemoteOptions:
     _metadata: Dict[str, Any] = field(default_factory=dict)
 
     def merged_with(self, **overrides) -> "RemoteOptions":
+        _validate_option_keys(overrides)
         clean = {k: v for k, v in overrides.items() if v is not None or k in ("name",)}
-        return replace(self, **clean)
+        out = replace(self, **clean)
+        if out.num_returns is not None and out.num_returns < 0:
+            raise ValueError("num_returns must be >= 0")
+        return out
 
     def task_resources(self, is_actor: bool = False) -> Dict[str, float]:
         res = dict(self.resources)
@@ -56,16 +60,18 @@ class RemoteOptions:
         return {k: v for k, v in res.items() if v}
 
 
-def options_from_kwargs(is_actor: bool, **kwargs) -> RemoteOptions:
-    valid = set(RemoteOptions.__dataclass_fields__)
-    # accept reference-compatible aliases
+def _validate_option_keys(kwargs):
     if "num_gpus" in kwargs:
         raise ValueError(
             "ray_tpu is a TPU-native framework: use num_tpus instead of num_gpus"
         )
-    unknown = set(kwargs) - valid
+    unknown = set(kwargs) - set(RemoteOptions.__dataclass_fields__)
     if unknown:
         raise ValueError(f"Unknown remote options: {sorted(unknown)}")
+
+
+def options_from_kwargs(is_actor: bool, **kwargs) -> RemoteOptions:
+    _validate_option_keys(kwargs)
     opts = RemoteOptions(**kwargs)
     if opts.num_returns < 0:
         raise ValueError("num_returns must be >= 0")

@@ -1,0 +1,266 @@
+"""GPT-2 in pure JAX, designed for the MXU and GSPMD sharding.
+
+Flagship model for the Train benchmarks (BASELINE.md config 3: GPT-2-124M
+data-parallel pretraining, tokens/sec/chip). TPU-first choices:
+
+- layers are *stacked* and iterated with ``lax.scan`` → compile time independent
+  of depth, XLA pipelines the layer loop;
+- weights carry logical axis names so any (dp, fsdp, tp, cp) mesh works via
+  parallel/sharding.py rules — no model changes for a new parallelism plan;
+- bf16 activations + matmuls (MXU native), f32 params/optimizer master copy;
+- vocab padded to a multiple of 128 (lane width) so the LM-head matmul tiles;
+- attention dispatches to the Pallas flash kernel on TPU (ops/attention.py) with
+  an XLA einsum fallback elsewhere, and to ring attention when the mesh has a
+  cp axis.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    seq_len: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    dropout: float = 0.0          # pretraining default; nonzero not yet implemented
+    dtype: Any = jnp.bfloat16     # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False           # jax.checkpoint each block (memory/flops trade)
+    attention_impl: str = "auto"  # auto | xla | pallas | ring
+    use_bias: bool = True
+
+    def __post_init__(self):
+        if self.dropout:
+            raise NotImplementedError(
+                "dropout is not implemented yet (needs rng threading through "
+                "the scan); pretraining runs use dropout=0"
+            )
+        if self.attention_impl not in ("auto", "xla", "pallas", "ring"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @property
+    def d_ff(self) -> int:
+        return 4 * self.d_model
+
+    @property
+    def padded_vocab(self) -> int:
+        return _round_up(self.vocab_size, 128)
+
+
+def gpt2_124m(**overrides) -> GPT2Config:
+    return replace(GPT2Config(), **overrides)
+
+
+def gpt2_350m(**overrides) -> GPT2Config:
+    return replace(
+        GPT2Config(n_layer=24, n_head=16, d_model=1024), **overrides
+    )
+
+
+def gpt2_tiny(**overrides) -> GPT2Config:
+    """Test-size config (CPU mesh friendly)."""
+    return replace(
+        GPT2Config(vocab_size=512, seq_len=128, n_layer=2, n_head=4, d_model=128),
+        **overrides,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Parameters
+# --------------------------------------------------------------------------- #
+
+def logical_axes(cfg: GPT2Config) -> Dict[str, Any]:
+    """Pytree (matching init() output) of logical axis names per parameter."""
+    blocks = {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "qkv_w": ("layers", "embed", None, "heads", "kv"),
+        "qkv_b": ("layers", None, "heads", "kv"),
+        "proj_w": ("layers", "heads", "kv", "embed"),
+        "proj_b": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+        "fc_w": ("layers", "embed", "mlp"),
+        "fc_b": ("layers", "mlp"),
+        "out_w": ("layers", "mlp", "embed"),
+        "out_b": ("layers", "embed"),
+    }
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": blocks,
+        "lnf_scale": ("embed",),
+        "lnf_bias": ("embed",),
+    }
+
+
+def init(cfg: GPT2Config, rng: jax.Array) -> Dict[str, Any]:
+    """GPT-2 initialization: N(0, 0.02), residual projections scaled 1/sqrt(2L)."""
+    D, H, hd, F, L = cfg.d_model, cfg.n_head, cfg.head_dim, cfg.d_ff, cfg.n_layer
+    V, S = cfg.padded_vocab, cfg.seq_len
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(rng, 8))
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+
+    def normal(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    blocks = {
+        "ln1_scale": jnp.ones((L, D), pd),
+        "ln1_bias": jnp.zeros((L, D), pd),
+        "qkv_w": normal(next(k), (L, D, 3, H, hd), std),
+        "qkv_b": jnp.zeros((L, 3, H, hd), pd),
+        "proj_w": normal(next(k), (L, H, hd, D), resid_std),
+        "proj_b": jnp.zeros((L, D), pd),
+        "ln2_scale": jnp.ones((L, D), pd),
+        "ln2_bias": jnp.zeros((L, D), pd),
+        "fc_w": normal(next(k), (L, D, F), std),
+        "fc_b": jnp.zeros((L, F), pd),
+        "out_w": normal(next(k), (L, F, D), resid_std),
+        "out_b": jnp.zeros((L, D), pd),
+    }
+    return {
+        "wte": normal(next(k), (V, D), std),
+        "wpe": normal(next(k), (S, D), 0.01),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((D,), pd),
+        "lnf_bias": jnp.zeros((D,), pd),
+    }
+
+
+def param_count(cfg: GPT2Config) -> int:
+    import numpy as np
+
+    return sum(
+        int(np.prod(p.shape))
+        for p in jax.tree.leaves(
+            jax.eval_shape(lambda: init(cfg, jax.random.PRNGKey(0)))
+        )
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------------- #
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    # f32 statistics for stability, cast back to compute dtype
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: GPT2Config):
+    """q,k,v: [B, S, H, hd] → [B, S, H, hd], causal."""
+    impl = cfg.attention_impl
+    if impl == "auto":
+        # pallas flash kernel becomes the TPU default once ops/attention.py
+        # benchmarks ahead of the XLA fusion; until then XLA everywhere.
+        impl = "xla"
+    if impl == "pallas":
+        try:
+            from ray_tpu.ops.attention import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "pallas flash attention kernel not available in this build"
+            ) from e
+        return flash_attention(q, k, v, causal=True)
+    if impl == "ring":
+        try:
+            from ray_tpu.ops.ring_attention import ring_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "ring attention kernel not available in this build"
+            ) from e
+        return ring_attention(q, k, v, axis_name="cp", causal=True)
+    # XLA path: einsum + mask; XLA fuses the softmax chain.
+    S = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, layer_params, cfg: GPT2Config):
+    """One transformer block. x: [B, S, D]."""
+    p = layer_params
+    dt = cfg.dtype
+    h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = jnp.einsum("bsd,dthk->bsthk", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,hd]
+    attn = _attention(q, k, v, cfg)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, p["proj_w"].astype(dt)) + p["proj_b"].astype(dt)
+    h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    h = jnp.einsum("bsd,df->bsf", h, p["fc_w"].astype(dt)) + p["fc_b"].astype(dt)
+    h = jax.nn.gelu(h, approximate=True)
+    x = x + jnp.einsum("bsf,fd->bsd", h, p["out_w"].astype(dt)) + p["out_b"].astype(dt)
+    return x
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, padded_vocab] (compute dtype)."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    wte = params["wte"].astype(dt)
+    x = wte[tokens] + params["wpe"][:S].astype(dt)
+
+    block_fn = partial(_block, cfg=cfg)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn, static_argnums=())
+
+    def scan_body(x, layer_params):
+        return block_fn(x, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    # tied LM head
+    logits = jnp.einsum("bsd,vd->bsv", x, wte)
+    return logits
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: GPT2Config,
+) -> jax.Array:
+    """Mean next-token cross-entropy. targets [B, S] int32 (-1 = ignore)."""
+    logits = forward(params, tokens, cfg).astype(jnp.float32)
+    V = cfg.padded_vocab
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    mask = targets >= 0
+    safe_targets = jnp.where(mask, targets, 0)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def flops_per_token(cfg: GPT2Config) -> float:
+    """Approximate training FLOPs per token (fwd+bwd ≈ 6N + attention term)."""
+    n = param_count(cfg)
+    attn = 12 * cfg.n_layer * cfg.d_model * cfg.seq_len  # 2*2*3 per token
+    return 6.0 * n + attn

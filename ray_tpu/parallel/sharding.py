@@ -1,0 +1,65 @@
+"""Logical-axis sharding rules: map model-space axis names onto mesh axes.
+
+GSPMD-style workflow: models annotate each parameter with *logical* axis names
+("vocab", "embed", "mlp", "heads", …); a rule table maps those to mesh axes; XLA
+inserts the collectives. This is the capability the reference lacks natively
+(SURVEY §2.10: TP/PP/SP "absent", delegated to external Alpa) and gets for free
+on TPU via pjit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis → mesh axis (or tuple of mesh axes). None = replicated.
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "cp",
+    "layers": None,          # layers are stacked + scanned, never sharded (pp
+                             # uses stage meshes instead — see parallel/pipeline)
+    "vocab": "tp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "expert": "ep",
+    "stage": "pp",
+}
+
+
+def spec_for(logical_axes: Sequence[Optional[str]], rules: Optional[Dict] = None) -> P:
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    dims = []
+    for ax in logical_axes:
+        if ax is None:
+            dims.append(None)
+        else:
+            dims.append(rules.get(ax))
+    return P(*dims)
+
+
+def tree_specs(logical_tree: Any, rules: Optional[Dict] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any, rules: Optional[Dict] = None) -> Any:
+    specs = tree_specs(logical_tree, rules)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def shard_tree(mesh: Mesh, tree: Any, logical_tree: Any, rules=None) -> Any:
+    """device_put a pytree of host arrays with its sharding (initial placement)."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)

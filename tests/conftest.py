@@ -7,12 +7,18 @@ SURVEY.md §4). Must be set before jax import — hence module-level os.environ 
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin (terminal sitecustomize) force-selects jax_platforms
+# "axon,cpu" at interpreter start; pin tests back to the virtual CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -84,3 +84,29 @@ def test_handle_pickles_with_method_metadata(ray_start_local):
 
     m = M.remote()
     assert ray.get(use.remote(m)) == [1, 2]
+
+
+def test_failed_init_releases_name_and_errors_calls(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return "pong"
+
+    h = Broken.options(name="fragile").remote()  # must NOT raise (async create)
+    # surfaces as ActorDiedError (init already failed) or the init exception
+    # itself (call raced ahead of construction) — both are acceptable
+    with pytest.raises((ray.exceptions.RayTpuError, RuntimeError)):
+        ray.get(h.ping.remote(), timeout=5)
+
+    @ray.remote
+    class Fine:
+        def ping(self):
+            return "ok"
+
+    h2 = Fine.options(name="fragile").remote()  # name released after init failure
+    assert ray.get(h2.ping.remote()) == "ok"
