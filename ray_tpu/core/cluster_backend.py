@@ -1,15 +1,335 @@
-"""Cluster backend: driver side of the real multi-process runtime.
+"""Cluster backend: the driver/worker side of the multi-process runtime.
 
-Milestone 3 (SURVEY.md §7 phases 1-2) replaces this stub with the full
-GCS + raylet + worker + shared-memory object-store runtime.
+Driver mode with no address bootstraps a single-node cluster (GCS + raylet
+subprocesses — parity: ray.init() starting gcs_server/raylet via
+services.py:1280,1353), then connects a CoreWorker. With an address it
+connects to an existing cluster. Worker mode wraps the WorkerAgent's
+CoreWorker so nested @remote calls inside tasks submit through the same
+runtime.
 """
 
 from __future__ import annotations
 
+import atexit
+import concurrent.futures
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-class ClusterBackend:
-    def __init__(self, **kwargs):
-        raise NotImplementedError(
-            "ray_tpu cluster mode is not built yet in this checkout; "
-            "use ray_tpu.init(local_mode=True) meanwhile"
+from ray_tpu import exceptions as exc
+from ray_tpu.core import rpc
+from ray_tpu.core.backend import Backend
+from ray_tpu.core.core_worker import CoreWorker
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.options import RemoteOptions
+from ray_tpu.core.refs import ObjectRef
+
+
+def _session_tmp_dir(session: str) -> str:
+    d = os.path.join("/tmp", "ray_tpu", session)
+    os.makedirs(os.path.join(d, "logs"), exist_ok=True)
+    return d
+
+
+class ProcessGroup:
+    """Daemon subprocesses this driver spawned (killed on shutdown)."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.procs: List[subprocess.Popen] = []
+
+    def spawn(self, name: str, argv: List[str], env=None) -> subprocess.Popen:
+        log = open(os.path.join(self.session_dir, "logs", f"{name}.log"), "ab")
+        env = dict(env or os.environ)
+        # daemons must import ray_tpu regardless of the driver's cwd/sys.path
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        p = subprocess.Popen(argv, stdout=log, stderr=subprocess.STDOUT, env=env)
+        self.procs.append(p)
+        return p
+
+    def shutdown(self):
+        for p in self.procs:
+            try:
+                p.terminate()
+            except ProcessLookupError:
+                pass
+        deadline = time.monotonic() + 3
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def daemon_env(keep_tpu: bool = False) -> dict:
+    """Daemon process environment. Unless the process will drive TPU compute,
+    strip accelerator plugin hooks (the terminal's sitecustomize imports jax +
+    the TPU plugin into EVERY interpreter when they're present — seconds of
+    startup and a useless TPU claim per daemon)."""
+    env = dict(os.environ)
+    if not keep_tpu:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def start_gcs(pg: ProcessGroup, port: int = 0) -> str:
+    port = port or _free_port()
+    pg.spawn(
+        "gcs",
+        [sys.executable, "-m", "ray_tpu.core.gcs.server", "--port", str(port)],
+        env=daemon_env(),
+    )
+    return f"127.0.0.1:{port}"
+
+
+def start_raylet(
+    pg: ProcessGroup,
+    gcs_address: str,
+    session: str,
+    node_id: str,
+    num_cpus=None,
+    num_tpus=None,
+    resources=None,
+    object_store_memory_mb=None,
+    port: int = 0,
+) -> None:
+    import json
+
+    if num_tpus is None:
+        # detect in THIS process (which has the TPU env) so the raylet daemon
+        # never needs to import jax — the reference's GPU autodetect gap,
+        # solved TPU-side (SURVEY §2.11 resource_spec.py:279)
+        from ray_tpu.core.resources import detect_tpu_resources
+
+        detected = detect_tpu_resources()
+        num_tpus = int(detected.get("TPU", 0))
+        resources = {**detected, **(resources or {})}
+        resources.pop("TPU", None)
+    argv = [
+        sys.executable, "-m", "ray_tpu.core.raylet.node_manager",
+        "--gcs", gcs_address, "--session", session, "--node-id", node_id,
+        "--resources", json.dumps(resources or {}),
+        "--num-tpus", str(num_tpus),
+    ]
+    if port:
+        argv += ["--port", str(port)]
+    if num_cpus is not None:
+        argv += ["--num-cpus", str(num_cpus)]
+    if object_store_memory_mb:
+        argv += ["--object-store-memory-mb", str(object_store_memory_mb)]
+    # raylet itself never runs user jax code (stripped env, fast start); the
+    # TPU vars ride along under a neutral name so worker_pool can restore them
+    # for workers on TPU nodes only.
+    env = daemon_env()
+    if num_tpus > 0:
+        preserved = {
+            k: os.environ[k]
+            for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+            if k in os.environ
+        }
+        env["RAY_TPU_PRESERVED_TPU_ENV"] = json.dumps(preserved)
+    pg.spawn(f"raylet-{node_id}", argv, env=env)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ClusterBackend(Backend):
+    def __init__(
+        self,
+        address: Optional[str] = None,
+        core_worker: Optional[CoreWorker] = None,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        node_name: Optional[str] = None,
+        log_to_driver: bool = True,
+    ):
+        self._procs: Optional[ProcessGroup] = None
+        if core_worker is not None:  # worker mode
+            self.core = core_worker
+            return
+        session = f"s{uuid.uuid4().hex[:10]}"
+        node_id = node_name or f"node-{uuid.uuid4().hex[:8]}"
+        if address is None:
+            self._procs = ProcessGroup(_session_tmp_dir(session))
+            gcs_address = start_gcs(self._procs)
+            start_raylet(
+                self._procs,
+                gcs_address,
+                session,
+                node_id,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+                object_store_memory_mb=(
+                    object_store_memory // (1024 * 1024)
+                    if object_store_memory
+                    else None
+                ),
+            )
+        else:
+            gcs_address = address
+        # connect driver core worker; discover the local raylet via GCS
+        self.core = CoreWorker(
+            gcs_address, None, session, node_id, mode="driver"
         )
+        self.core.connect()
+        raylet_addr, raylet_session, raylet_node = self._wait_local_raylet(
+            prefer_node=node_id
+        )
+        self.core.raylet_address = raylet_addr
+        self.core.session = raylet_session
+        self.core.node_id = raylet_node
+        # rebind shm client to the raylet's session (objects shared on-node)
+        from ray_tpu.core.object_store.shm_store import ShmClient
+
+        self.core.shm = ShmClient(raylet_session)
+        self.core.raylet = self.core.io.run(
+            rpc.connect(raylet_addr, handler=self.core, name="driver->raylet")
+        )
+
+    def _wait_local_raylet(self, prefer_node: str, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            nodes = self.core.io.run(self.core.gcs.call("get_nodes"))
+            if nodes:
+                node = next(
+                    (n for n in nodes if n["NodeID"] == prefer_node), nodes[0]
+                )
+                if node["Alive"]:
+                    return (
+                        node["NodeManagerAddress"],
+                        node["Session"],
+                        node["NodeID"],
+                    )
+            time.sleep(0.1)
+        raise exc.RayTpuError("no raylet registered within timeout")
+
+    # ------------------------------------------------------------- Backend
+    def submit_task(self, func, args, kwargs, options):
+        return self.core.submit_task(func, args, kwargs, options)
+
+    def create_actor(self, cls, args, kwargs, options):
+        return self.core.create_actor(cls, args, kwargs, options)
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
+        return self.core.submit_actor_task(actor_id, method_name, args, kwargs, options)
+
+    def put(self, value):
+        return self.core.put(value)
+
+    def get(self, refs, timeout):
+        return self.core.get(refs, timeout)
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        return self.core.wait(refs, num_returns, timeout, fetch_local)
+
+    def as_future(self, ref: ObjectRef):
+        out: concurrent.futures.Future = concurrent.futures.Future()
+
+        async def resolve():
+            try:
+                data = await self.core._fetch_serialized(ref, None)
+                if isinstance(data, BaseException):
+                    e = data
+                    if isinstance(e, exc.TaskError):
+                        e = e.as_instanceof_cause()
+                    out.set_exception(e)
+                else:
+                    from ray_tpu.core import serialization
+
+                    out.set_result(serialization.loads(data))
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        self.core.io.spawn(resolve())
+        return out
+
+    def kill_actor(self, actor_id, no_restart):
+        self.core.kill_actor(actor_id, no_restart)
+
+    def free_actor(self, actor_id):
+        try:
+            self.core.kill_actor(actor_id, True)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
+
+    def cancel(self, ref, force, recursive):
+        pass  # cooperative cancellation lands with the task event channel
+
+    def get_named_actor(self, name, namespace):
+        return self.core.get_named_actor(name, namespace)
+
+    def cluster_resources(self):
+        nodes = self.core.io.run(self.core.gcs.call("get_nodes"))
+        out: Dict[str, float] = {}
+        for n in nodes:
+            if n["Alive"]:
+                for k, v in n["Resources"].items():
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def available_resources(self):
+        nodes = self.core.io.run(self.core.gcs.call("get_nodes"))
+        out: Dict[str, float] = {}
+        for n in nodes:
+            if n["Alive"]:
+                for k, v in n["Available"].items():
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def nodes(self):
+        return self.core.io.run(self.core.gcs.call("get_nodes"))
+
+    # placement groups (used by util/placement_group.py)
+    def create_placement_group(self, pg_id, bundles, strategy, timeout=30.0):
+        return self.core.io.run(
+            self.core.gcs.call(
+                "create_placement_group",
+                pg_id=pg_id,
+                bundles=bundles,
+                strategy=strategy,
+                create_timeout=timeout,
+                timeout=timeout + 10,
+            )
+        )
+
+    def remove_placement_group(self, pg_id):
+        return self.core.io.run(
+            self.core.gcs.call("remove_placement_group", pg_id=pg_id)
+        )
+
+    def get_placement_group(self, pg_id):
+        return self.core.io.run(
+            self.core.gcs.call("get_placement_group", pg_id=pg_id)
+        )
+
+    def shutdown(self):
+        try:
+            self.core.shutdown()
+        finally:
+            if self._procs:
+                self._procs.shutdown()
+                # reclaim tmpfs (real RAM): this driver owns the session
+                try:
+                    from ray_tpu.core.object_store.shm_store import ShmClient
+
+                    ShmClient(self.core.session).destroy()
+                except Exception:  # noqa: BLE001
+                    pass

@@ -1,0 +1,699 @@
+"""CoreWorker: per-process runtime embedded in drivers and workers.
+
+Parity: src/ray/core_worker/core_worker.h:284 — task submission, ownership
+(the submitting process owns returned refs and serves their values/locations:
+reference_count.h:61), in-process memory store for small objects, shm object
+store for large ones, direct worker-to-worker task push (direct_task_transport),
+per-actor ordered submission queues (direct_actor_task_submitter).
+
+Every CoreWorker runs an RPC server on the io-loop thread; owners serve
+`get_object_info` from it, workers additionally accept `push_task` /
+`push_actor_task` (handled in worker_main.WorkerAgent which subclasses this).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import rpc, serialization, task_spec as ts
+from ray_tpu.core.config import _config
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store.shm_store import ShmClient
+from ray_tpu.core.options import RemoteOptions
+from ray_tpu.core.refs import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class _MemoryStore:
+    """In-process store for small/owned objects (store_provider/memory_store)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._objects: Dict[ObjectID, Any] = {}   # oid -> ("val", bytes) | ("err", exc)
+        self._events: Dict[ObjectID, asyncio.Event] = {}
+
+    def _event(self, oid) -> asyncio.Event:
+        ev = self._events.get(oid)
+        if ev is None:
+            ev = asyncio.Event()
+            self._events[oid] = ev
+        return ev
+
+    def put_value(self, oid: ObjectID, data: bytes):
+        self._objects[oid] = ("val", data)
+        self._loop.call_soon_threadsafe(self._event(oid).set) if (
+            threading.current_thread().name != "ray-tpu-io"
+        ) else self._event(oid).set()
+
+    def put_error(self, oid: ObjectID, error: BaseException):
+        self._objects[oid] = ("err", error)
+        if threading.current_thread().name != "ray-tpu-io":
+            self._loop.call_soon_threadsafe(self._event(oid).set)
+        else:
+            self._event(oid).set()
+
+    def contains(self, oid: ObjectID) -> bool:
+        return oid in self._objects
+
+    def peek(self, oid: ObjectID):
+        return self._objects.get(oid)
+
+    async def wait_for(self, oid: ObjectID, timeout: Optional[float]):
+        if oid not in self._objects:
+            try:
+                await asyncio.wait_for(self._event(oid).wait(), timeout)
+            except asyncio.TimeoutError:
+                raise exc.GetTimeoutError(f"object {oid.hex()[:16]} not ready")
+        return self._objects[oid]
+
+    def delete(self, oid: ObjectID):
+        self._objects.pop(oid, None)
+        self._events.pop(oid, None)
+
+
+class CoreWorker:
+    """Driver/worker shared runtime. Thread model: user threads call the
+    public methods; all networking happens on the private io-loop thread."""
+
+    def __init__(
+        self,
+        gcs_address: str,
+        raylet_address: Optional[str],
+        session: str,
+        node_id: str,
+        mode: str = "driver",
+    ):
+        self.worker_id = WorkerID.from_random()
+        self.mode = mode
+        self.session = session
+        self.node_id = node_id
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.io = rpc.EventLoopThread(name="ray-tpu-io")
+        self.memory_store = _MemoryStore(self.io.loop)
+        self.shm = ShmClient(session)
+        # ownership tables
+        self.locations: Dict[ObjectID, dict] = {}     # owned shm objects
+        self.submitted_specs: Dict[TaskID, ts.TaskSpec] = {}  # lineage
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._registered_fns: set = set()
+        self._actor_addr_cache: Dict[bytes, str] = {}
+        self._actor_queues: Dict[bytes, asyncio.Queue] = {}
+        self._actor_conns: Dict[str, rpc.Connection] = {}
+        self._worker_conns: Dict[str, rpc.Connection] = {}
+        self._raylet_conns: Dict[str, rpc.Connection] = {}
+        self.server: Optional[rpc.RpcServer] = None
+        self.gcs: Optional[rpc.Connection] = None
+        self.raylet: Optional[rpc.Connection] = None
+        self.address: Optional[str] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+    def connect(self):
+        self.io.run(self._connect_async(), timeout=60)
+        return self
+
+    async def _connect_async(self):
+        self.server = rpc.RpcServer(self)
+        await self.server.start()
+        self.address = self.server.address
+        # generous retry window: daemons may still be importing (cold start on
+        # a loaded host takes seconds)
+        self.gcs = await rpc.connect(
+            self.gcs_address, handler=self, name=f"{self.mode}->gcs",
+            retries=150, retry_delay=0.2,
+        )
+        if self.raylet_address:
+            self.raylet = await rpc.connect(
+                self.raylet_address, handler=self, name=f"{self.mode}->raylet"
+            )
+        if self.mode == "driver":
+            await self.gcs.call("register_driver")
+
+    def shutdown(self):
+        try:
+            self.io.run(self._shutdown_async(), timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        self.io.stop()
+
+    async def _shutdown_async(self):
+        for conn in (
+            list(self._worker_conns.values())
+            + list(self._actor_conns.values())
+            + list(self._raylet_conns.values())
+        ):
+            await conn.close()
+        if self.gcs:
+            await self.gcs.close()
+        if self.raylet:
+            await self.raylet.close()
+        if self.server:
+            await self.server.close()
+        # stop actor-queue consumers etc. so the loop closes cleanly
+        me = asyncio.current_task()
+        for t in asyncio.all_tasks():
+            if t is not me:
+                t.cancel()
+
+    # ---------------------------------------------------------- owner RPCs
+    async def handle_get_object_info(self, conn, oid_hex):
+        """Serve an owned object to a remote consumer: inline value, error, or
+        shm location. `pending` while the producing task still runs."""
+        oid = ObjectID.from_hex(oid_hex)
+        entry = self.memory_store.peek(oid)
+        if entry is not None:
+            kind, payload = entry
+            if kind == "err":
+                return {"error": cloudpickle.dumps(payload)}
+            if payload is not None:  # None = marker: value lives in shm
+                return {"inline": payload}
+        loc = self.locations.get(oid)
+        if loc is not None:
+            return {"location": loc}
+        return {"pending": True}
+
+    def handle_ping(self, conn):
+        return "pong"
+
+    # ------------------------------------------------------------- put/get
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.worker_id)
+        data = serialization.serialize(value).to_bytes()
+        ref = ObjectRef(oid, owner_addr=self.address)
+        if len(data) <= _config.max_direct_call_object_size:
+            self.memory_store.put_value(oid, data)
+        else:
+            self._put_shm(oid, data)
+        return ref
+
+    def _put_shm(self, oid: ObjectID, data: bytes):
+        self.shm.put_bytes(oid, data)
+        self.locations[oid] = {
+            "session": self.session,
+            "raylet_addr": self.raylet_address,
+            "node_id": self.node_id,
+            "nbytes": len(data),
+        }
+        if self.raylet:
+            self.io.spawn(self._notify_object_added(oid, len(data)))
+
+    async def _notify_object_added(self, oid, nbytes):
+        try:
+            await self.raylet.call("object_added", oid_hex=oid.hex(), nbytes=nbytes)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            pass
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        return self.io.run(
+            self._get_async(list(refs), timeout),
+            timeout=None if timeout is None else timeout + 30,
+        )
+
+    async def _get_async(self, refs: List[ObjectRef], timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = (
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            out.append(await self._get_one(ref, remaining))
+        return out
+
+    async def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
+        data = await self._fetch_serialized(ref, timeout)
+        if isinstance(data, BaseException):
+            raise (
+                data.as_instanceof_cause()
+                if isinstance(data, exc.TaskError)
+                else data
+            )
+        return serialization.loads(data)
+
+    async def _fetch_serialized(self, ref: ObjectRef, timeout: Optional[float]):
+        """Returns serialized bytes/buffer or an exception instance."""
+        oid = ref.id
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # 1) owned shm objects (ray.put of large values records a location
+        #    without touching the memory store)
+        if oid in self.locations:
+            return await self._read_location(oid, self.locations[oid])
+        # 2) local shm store (results produced on this node)
+        buf = self.shm.get(oid)
+        if buf is not None:
+            return buf.buffer
+        # 3) own memory store (inline values + pending task results)
+        if self.memory_store.contains(oid) or ref.owner_addr in (None, self.address):
+            kind, payload = await self.memory_store.wait_for(oid, timeout)
+            if kind == "err":
+                return payload
+            if payload is None:  # marker: result went to shm
+                loc = self.locations.get(oid)
+                return await self._read_location(oid, loc)
+            return payload
+        # 3) ask the owner
+        while True:
+            info = await self._ask_owner(ref)
+            if info is None:
+                return exc.ObjectLostError(oid, "owner unreachable")
+            if "error" in info:
+                return cloudpickle.loads(info["error"])
+            if "inline" in info:
+                return info["inline"]
+            if "location" in info:
+                return await self._read_location(oid, info["location"])
+            # pending — poll with backoff
+            if deadline is not None and time.monotonic() > deadline:
+                raise exc.GetTimeoutError(f"get timed out on {oid.hex()[:16]}")
+            await asyncio.sleep(0.01)
+
+    async def _ask_owner(self, ref: ObjectRef):
+        conn = await self._conn_to(ref.owner_addr, kind="worker")
+        if conn is None:
+            return None
+        try:
+            return await conn.call("get_object_info", oid_hex=ref.id.hex(), timeout=30)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            return None
+
+    async def _read_location(self, oid: ObjectID, loc: Optional[dict]):
+        if loc is None:
+            return exc.ObjectLostError(oid, "no location")
+        if loc["session"] == self.session:
+            buf = self.shm.get(oid)
+            if buf is not None:
+                return buf.buffer
+        # remote node: ask local raylet to pull, then read locally
+        if self.raylet is not None:
+            ok = await self.raylet.call(
+                "pull_object",
+                oid_hex=oid.hex(),
+                source_addr=loc["raylet_addr"],
+                timeout=120,
+            )
+            if ok:
+                buf = self.shm.get(oid)
+                if buf is not None:
+                    return buf.buffer
+        # last resort: fetch bytes straight from the remote raylet
+        conn = await self._conn_to(loc["raylet_addr"], kind="raylet")
+        if conn is not None:
+            try:
+                data = await conn.call("fetch_object", oid_hex=oid.hex(), timeout=120)
+                if data is not None:
+                    return data
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+        return exc.ObjectLostError(oid, "object unavailable on all nodes")
+
+    async def _conn_to(self, addr: Optional[str], kind: str):
+        if addr is None:
+            return None
+        cache = self._raylet_conns if kind == "raylet" else self._worker_conns
+        conn = cache.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        try:
+            conn = await rpc.connect(addr, handler=self, retries=3, name=f"->{addr}")
+        except rpc.ConnectionLost:
+            return None
+        cache[addr] = conn
+        return conn
+
+    def wait(
+        self, refs, num_returns: int, timeout: Optional[float], fetch_local: bool
+    ):
+        return self.io.run(
+            self._wait_async(list(refs), num_returns, timeout),
+        )
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            still = []
+            for ref in pending:
+                if await self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            await asyncio.sleep(0.01)
+        return ready, [r for r in refs if r not in ready]
+
+    async def _is_ready(self, ref: ObjectRef) -> bool:
+        if self.memory_store.contains(ref.id) or ref.id in self.locations:
+            return True
+        if self.shm.contains(ref.id):
+            return True
+        if ref.owner_addr and ref.owner_addr != self.address:
+            info = await self._ask_owner(ref)
+            return info is not None and "pending" not in info
+        return False
+
+    # ------------------------------------------------------- task submission
+    def register_function(self, fn) -> bytes:
+        blob = cloudpickle.dumps(fn)
+        fn_id = ts.function_id(blob)
+        if fn_id not in self._registered_fns:
+            self.io.run(self.gcs.call("register_function", fn_id=fn_id, blob=blob))
+            self._registered_fns.add(fn_id)
+            self._fn_cache[fn_id] = fn
+        return fn_id
+
+    async def load_function(self, fn_id: bytes):
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            blob = await self.gcs.call("get_function", fn_id=fn_id)
+            if blob is None:
+                raise exc.RayTpuError(f"function {fn_id.hex()} not in registry")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    def submit_task(self, func, args, kwargs, options: RemoteOptions):
+        fn_id = self.register_function(func)
+        task_id = TaskID.from_random()
+        enc_args, enc_kwargs = ts.encode_args(args, kwargs, self.put)
+        pg_id, pg_index = _pg_fields(options)
+        spec = ts.TaskSpec(
+            task_id=task_id,
+            name=getattr(func, "__name__", "task"),
+            fn_id=fn_id,
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=max(1, options.num_returns),
+            resources=options.task_resources(),
+            owner_addr=self.address,
+            max_retries=(
+                options.max_retries
+                if options.max_retries is not None
+                else _config.task_max_retries
+            ),
+            retry_exceptions=options.retry_exceptions,
+            scheduling_strategy=options.scheduling_strategy,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=pg_index,
+        )
+        self.submitted_specs[task_id] = spec
+        refs = spec.return_refs()
+        self.io.spawn(self._submit_and_track(spec, refs))
+        return refs
+
+    async def _submit_and_track(self, spec: ts.TaskSpec, refs: List[ObjectRef]):
+        attempts = 0
+        while True:
+            try:
+                result = await self._submit_once(spec)
+                self._store_task_result(spec, refs, result)
+                return
+            except exc.WorkerCrashedError as e:
+                attempts += 1
+                # max_retries counts SYSTEM failures (worker/node death), like
+                # the reference's task retry semantics; user exceptions retry
+                # only with retry_exceptions (worker-side)
+                if attempts <= spec.max_retries:
+                    logger.warning(
+                        "task %s worker crashed; retry %d", spec.name, attempts
+                    )
+                    continue
+                self._store_task_error(refs, e)
+                return
+            except exc.RayTpuError as e:
+                self._store_task_error(refs, e)
+                return
+            except Exception as e:  # noqa: BLE001 - protocol failure
+                self._store_task_error(
+                    refs, exc.RayTpuError(f"task submission failed: {e!r}")
+                )
+                return
+
+    async def _submit_once(self, spec: ts.TaskSpec) -> dict:
+        raylet = self.raylet
+        raylet_addr = self.raylet_address
+        if spec.placement_group_id is not None:
+            # route straight to a raylet holding the target bundle
+            addr = await self._pg_node_addr(
+                spec.placement_group_id, spec.placement_group_bundle_index
+            )
+            if addr is not None and addr != raylet_addr:
+                conn = await self._conn_to(addr, kind="raylet")
+                if conn is None:
+                    raise exc.RayTpuError(f"placement-group node {addr} gone")
+                raylet, raylet_addr = conn, addr
+        for _hop in range(8):  # spillback chain bound
+            reply = await raylet.call(
+                "request_lease",
+                resources=spec.resources,
+                pg_id=spec.placement_group_id,
+                bundle_index=spec.placement_group_bundle_index,
+                timeout=None,
+            )
+            if "granted" in reply:
+                return await self._push_to_worker(
+                    raylet, raylet_addr, reply, spec
+                )
+            if "spillback" in reply:
+                raylet_addr = reply["spillback"]
+                conn = await self._conn_to(raylet_addr, kind="raylet")
+                if conn is None:
+                    raise exc.RayTpuError(f"spillback target {raylet_addr} gone")
+                raylet = conn
+                continue
+            raise exc.RayTpuError(
+                f"task {spec.name} infeasible: {reply.get('reason')}"
+            )
+        raise exc.RayTpuError("spillback loop exceeded")
+
+    async def _push_to_worker(self, raylet, raylet_addr, lease, spec) -> dict:
+        worker_addr = lease["granted"]
+        lease_id = lease["lease_id"]
+        try:
+            conn = await self._conn_to(worker_addr, kind="worker")
+            if conn is None:
+                raise exc.WorkerCrashedError(f"cannot reach worker {worker_addr}")
+            blob = cloudpickle.dumps(spec)
+            logger.debug(
+                "pushing %s %s -> %s", spec.name, spec.task_id.hex()[:8], worker_addr
+            )
+            try:
+                result = await conn.call("push_task", spec_blob=blob, timeout=None)
+                logger.debug("pushed %s %s done", spec.name, spec.task_id.hex()[:8])
+                return result
+            except rpc.ConnectionLost as e:
+                raise exc.WorkerCrashedError(str(e)) from e
+        finally:
+            try:
+                await raylet.call("return_lease", lease_id=lease_id, timeout=10)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+
+    async def _pg_node_addr(self, pg_id: bytes, bundle_index: int):
+        info = await self.gcs.call("get_placement_group", pg_id=pg_id, timeout=30)
+        if not info or not info.get("placement"):
+            return None
+        placement = info["placement"]
+        node_id = placement[max(0, bundle_index)]
+        view = await self.gcs.call("get_resource_view", timeout=30)
+        node = view.get(node_id)
+        return node["address"] if node else None
+
+    def _store_task_result(self, spec, refs, result: dict):
+        """result: {"results": [(kind, payload), ...]} kind: inline|location|error"""
+        entries = result["results"]
+        for ref, (kind, payload) in zip(refs, entries):
+            if kind == "inline":
+                self.memory_store.put_value(ref.id, payload)
+            elif kind == "location":
+                self.locations[ref.id] = payload
+                # marker so local waiters wake up and read the location
+                self.memory_store.put_value(ref.id, None)
+            elif kind == "error":
+                err = cloudpickle.loads(payload)
+                self.memory_store.put_error(ref.id, err)
+
+    def _store_task_error(self, refs, error: BaseException):
+        for ref in refs:
+            self.memory_store.put_error(ref.id, error)
+
+    # ---------------------------------------------------------- actor calls
+    def create_actor(self, cls, args, kwargs, options: RemoteOptions) -> ActorID:
+        actor_id = ActorID.from_random()
+        blob = cloudpickle.dumps(cls)
+        fn_id = ts.function_id(blob)
+        if fn_id not in self._registered_fns:
+            self.io.run(self.gcs.call("register_function", fn_id=fn_id, blob=blob))
+            self._registered_fns.add(fn_id)
+        enc_args, enc_kwargs = ts.encode_args(args, kwargs, self.put)
+        spec = ts.TaskSpec(
+            task_id=TaskID.from_random(),
+            name=f"{cls.__name__}.__init__",
+            fn_id=fn_id,
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=0,
+            resources=options.task_resources(is_actor=True),
+            owner_addr=self.address,
+            actor_id=actor_id,
+            is_actor_creation=True,
+            actor_options={"max_concurrency": options.max_concurrency},
+        )
+        reply = self.io.run(
+            self.gcs.call(
+                "create_actor",
+                actor_id=actor_id.binary(),
+                spec_blob=cloudpickle.dumps(spec),
+                name=options.name,
+                namespace=options.namespace or "default",
+                detached=options.lifetime == "detached",
+                max_restarts=options.max_restarts,
+                resources=spec.resources,
+                get_if_exists=options.get_if_exists,
+            )
+        )
+        return ActorID(reply["actor_id"])
+
+    def submit_actor_task(self, actor_id: ActorID, method, args, kwargs,
+                          options: RemoteOptions):
+        task_id = TaskID.from_random()
+        enc_args, enc_kwargs = ts.encode_args(args, kwargs, self.put)
+        spec = ts.TaskSpec(
+            task_id=task_id,
+            name=method,
+            fn_id=b"",
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=max(1, options.num_returns),
+            resources={},
+            owner_addr=self.address,
+            actor_id=actor_id,
+            actor_method=method,
+            max_retries=options.max_task_retries,
+        )
+        refs = spec.return_refs()
+        # Per-actor FIFO: one consumer pushes calls strictly in submission
+        # order, awaiting each response before the next send. This keeps
+        # ordering correct across actor RESTARTS with no sequence-number
+        # protocol (the reference pipelines with seq_nos —
+        # direct_actor_task_submitter.h; pipelining here is a future
+        # optimization, it changes throughput not semantics).
+        with self._lock:
+            q = self._actor_queues.get(actor_id.binary())
+            if q is None:
+                q = asyncio.Queue()
+                self._actor_queues[actor_id.binary()] = q
+                self.io.spawn(self._actor_queue_consumer(q))
+        self.io.loop.call_soon_threadsafe(q.put_nowait, (spec, refs))
+        return refs
+
+    async def _actor_queue_consumer(self, q: asyncio.Queue):
+        while True:
+            spec, refs = await q.get()
+            try:
+                await self._submit_actor_task_async(spec, refs)
+            except Exception as e:  # noqa: BLE001 - consumer must not die
+                self._store_task_error(
+                    refs, exc.RayTpuError(f"actor submission failed: {e!r}")
+                )
+
+    async def _submit_actor_task_async(self, spec: ts.TaskSpec, refs):
+        # in-flight failures burn max_task_retries (reference semantics);
+        # stale-address resolution failures retry on their own budget —
+        # a restarting actor must not fail calls that were never delivered
+        call_retries = max(0, spec.max_retries)
+        call_attempt = 0
+        resolve_attempt = 0
+        while True:
+            addr = await self._resolve_actor(spec.actor_id.binary())
+            if addr is None:
+                self._store_task_error(
+                    refs, exc.ActorDiedError(spec.actor_id, "actor is dead")
+                )
+                return
+            conn = await self._conn_to(addr, kind="worker")
+            if conn is None:
+                self._actor_addr_cache.pop(spec.actor_id.binary(), None)
+                resolve_attempt += 1
+                if resolve_attempt > 10:
+                    self._store_task_error(
+                        refs, exc.ActorDiedError(spec.actor_id, "unreachable")
+                    )
+                    return
+                await asyncio.sleep(_config.actor_restart_backoff_s)
+                continue
+            try:
+                result = await conn.call(
+                    "push_actor_task",
+                    spec_blob=cloudpickle.dumps(spec),
+                    timeout=None,
+                )
+                self._store_task_result(spec, refs, result)
+                return
+            except rpc.ConnectionLost:
+                self._actor_addr_cache.pop(spec.actor_id.binary(), None)
+                call_attempt += 1
+                if call_attempt > call_retries:
+                    self._store_task_error(
+                        refs,
+                        exc.ActorDiedError(
+                            spec.actor_id, "actor worker died during call"
+                        ),
+                    )
+                    return
+                await asyncio.sleep(_config.actor_restart_backoff_s)
+
+    async def _resolve_actor(self, actor_id: bytes) -> Optional[str]:
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr:
+            return addr
+        info = await self.gcs.call(
+            "get_actor", actor_id=actor_id, wait_alive=True,
+            wait_timeout=60, timeout=90,
+        )
+        if info is None or info["state"] != "ALIVE":
+            return None
+        self._actor_addr_cache[actor_id] = info["address"]
+        return info["address"]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool):
+        self.io.run(
+            self.gcs.call(
+                "kill_actor", actor_id=actor_id.binary(), no_restart=no_restart
+            )
+        )
+        self._actor_addr_cache.pop(actor_id.binary(), None)
+
+    def get_named_actor(self, name: str, namespace: Optional[str]) -> ActorID:
+        info = self.io.run(
+            self.gcs.call(
+                "get_named_actor", name=name, namespace=namespace or "default"
+            )
+        )
+        if info is None:
+            raise ValueError(f"Failed to look up actor '{name}'")
+        return ActorID(info["actor_id"])
+
+
+def _pg_fields(options: RemoteOptions):
+    pg = options.placement_group
+    if pg is None:
+        return None, -1
+    from ray_tpu.util.placement_group import PlacementGroup
+
+    if isinstance(pg, PlacementGroup):
+        return pg.id.binary(), options.placement_group_bundle_index
+    return pg, options.placement_group_bundle_index

@@ -1,0 +1,521 @@
+"""GCS — the cluster control plane.
+
+Parity: src/ray/gcs/gcs_server/ (gcs_server.cc:133-178 wires the same manager
+set): node membership + health checks, KV store, function registry, actor
+lifecycle + restarts, placement groups, resource view aggregation, pubsub.
+Single asyncio process; all state in memory (Redis-backed persistence is a
+later flag, mirroring gcs_storage="memory" default in ray_config_def.h:398).
+
+Connections are bidirectional: raylets register once and the same connection
+carries GCS→raylet commands (create worker, kill, reserve bundle) — no
+separate client channel needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import _config
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.scheduling_policy import NodeView, hybrid_policy, pack_bundles
+
+logger = logging.getLogger(__name__)
+
+# actor states (gcs.proto ActorTableData analog)
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    address: str                     # raylet rpc address
+    session: str                     # shm session name (object store)
+    total: ResourceSet = field(default_factory=ResourceSet)
+    available: ResourceSet = field(default_factory=ResourceSet)
+    labels: Dict[str, str] = field(default_factory=dict)
+    conn: Any = None
+    alive: bool = True
+    last_report: float = field(default_factory=time.monotonic)
+
+    def view(self) -> NodeView:
+        return NodeView(
+            node_id=self.node_id,
+            total=self.total,
+            available=self.available,
+            alive=self.alive,
+            labels=self.labels,
+        )
+
+    def public(self) -> dict:
+        return {
+            "NodeID": self.node_id,
+            "NodeManagerAddress": self.address,
+            "Session": self.session,
+            "Alive": self.alive,
+            "Resources": self.total.to_dict(),
+            "Available": self.available.to_dict(),
+            "Labels": dict(self.labels),
+        }
+
+
+@dataclass
+class ActorInfo:
+    actor_id: bytes
+    spec_blob: bytes                # pickled creation TaskSpec
+    state: str = PENDING
+    address: Optional[str] = None   # actor worker rpc address
+    node_id: Optional[str] = None
+    name: Optional[str] = None
+    namespace: str = "default"
+    detached: bool = False
+    owner_conn: Any = None          # driver/worker connection that owns it
+    restarts_left: int = 0
+    max_restarts: int = 0
+    resources: Dict[str, float] = field(default_factory=dict)
+    death_reason: str = ""
+    num_restarts: int = 0
+
+    def public(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "name": self.name,
+            "namespace": self.namespace,
+            "death_reason": self.death_reason,
+            "num_restarts": self.num_restarts,
+        }
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: bytes
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "PENDING"
+    placement: Optional[List[str]] = None  # node_id per bundle
+    creator_conn: Any = None
+    detached: bool = False
+
+
+class GcsServer:
+    def __init__(self, host="127.0.0.1", port=0):
+        self.server = rpc.RpcServer(self, host=host, port=port)
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.functions: Dict[bytes, bytes] = {}
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self.placement_groups: Dict[bytes, PlacementGroupInfo] = {}
+        self.subscribers: Dict[str, Set[rpc.Connection]] = {}
+        self.job_counter = 0
+        self._conn_owned_actors: Dict[rpc.Connection, Set[bytes]] = {}
+        self._conn_owned_pgs: Dict[rpc.Connection, Set[bytes]] = {}
+        self._bg: List[asyncio.Task] = []
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self):
+        await self.server.start()
+        self._bg.append(asyncio.create_task(self._health_check_loop()))
+        logger.info("GCS listening on %s", self.server.address)
+        return self.server.address
+
+    async def close(self):
+        for t in self._bg:
+            t.cancel()
+        await self.server.close()
+
+    # ------------------------------------------------------------- pubsub
+    async def publish(self, channel: str, payload):
+        dead = []
+        for conn in self.subscribers.get(channel, set()):
+            try:
+                await conn.push(channel, payload)
+            except rpc.ConnectionLost:
+                dead.append(conn)
+        for c in dead:
+            self.subscribers.get(channel, set()).discard(c)
+
+    def handle_subscribe(self, conn, channels: List[str]):
+        for ch in channels:
+            self.subscribers.setdefault(ch, set()).add(conn)
+        return True
+
+    # -------------------------------------------------------------- nodes
+    async def handle_register_node(
+        self, conn, node_id, address, session, resources, labels=None
+    ):
+        total = ResourceSet(resources)
+        self.nodes[node_id] = NodeInfo(
+            node_id=node_id,
+            address=address,
+            session=session,
+            total=total,
+            available=total,
+            labels=labels or {},
+            conn=conn,
+        )
+        conn.node_id = node_id
+        await self.publish("node", {"event": "added", "node": self.nodes[node_id].public()})
+        return {"node_id": node_id, "num_nodes": len(self.nodes)}
+
+    def handle_resource_report(self, conn, node_id, available):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return False
+        node.available = ResourceSet(available)
+        node.last_report = time.monotonic()
+        if not node.alive:
+            node.alive = True  # recovered
+        return True
+
+    def handle_get_nodes(self, conn):
+        return [n.public() for n in self.nodes.values()]
+
+    def handle_get_resource_view(self, conn):
+        return {
+            n.node_id: {
+                "total": n.total.to_dict(),
+                "available": n.available.to_dict(),
+                "alive": n.alive,
+                "address": n.address,
+                "session": n.session,
+            }
+            for n in self.nodes.values()
+        }
+
+    async def _health_check_loop(self):
+        period = _config.health_check_period_ms / 1000
+        threshold = period * _config.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_report > threshold:
+                    await self._on_node_dead(node, "missed health checks")
+
+    async def _on_node_dead(self, node: NodeInfo, reason: str):
+        node.alive = False
+        logger.warning("node %s dead: %s", node.node_id, reason)
+        await self.publish("node", {"event": "dead", "node_id": node.node_id})
+        # fail over actors on that node
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in (ALIVE, PENDING):
+                await self._on_actor_failure(actor, f"node {node.node_id} died")
+
+    # ----------------------------------------------------------------- kv
+    def handle_kv_put(self, conn, ns, key, value, overwrite=True):
+        k = (ns, key)
+        if not overwrite and k in self.kv:
+            return False
+        self.kv[k] = value
+        return True
+
+    def handle_kv_get(self, conn, ns, key):
+        return self.kv.get((ns, key))
+
+    def handle_kv_del(self, conn, ns, key):
+        return self.kv.pop((ns, key), None) is not None
+
+    def handle_kv_keys(self, conn, ns, prefix=""):
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    # ---------------------------------------------------------- functions
+    def handle_register_function(self, conn, fn_id, blob):
+        self.functions[fn_id] = blob
+        return True
+
+    def handle_get_function(self, conn, fn_id):
+        return self.functions.get(fn_id)
+
+    # -------------------------------------------------------------- jobs
+    def handle_register_driver(self, conn, metadata=None):
+        self.job_counter += 1
+        conn.is_driver = True
+        return {"job_id": self.job_counter}
+
+    # ------------------------------------------------------------- actors
+    async def handle_create_actor(
+        self,
+        conn,
+        actor_id,
+        spec_blob,
+        name=None,
+        namespace="default",
+        detached=False,
+        max_restarts=0,
+        resources=None,
+        get_if_exists=False,
+    ):
+        if name:
+            key = (namespace, name)
+            existing = self.named_actors.get(key)
+            if existing is not None and self.actors[existing].state != DEAD:
+                if get_if_exists:
+                    return {"actor_id": existing, "existing": True}
+                raise ValueError(f"actor name {name!r} already taken")
+            self.named_actors[key] = actor_id
+        info = ActorInfo(
+            actor_id=actor_id,
+            spec_blob=spec_blob,
+            name=name,
+            namespace=namespace,
+            detached=detached,
+            owner_conn=None if detached else conn,
+            max_restarts=max_restarts,
+            restarts_left=max_restarts,
+            resources=resources or {},
+        )
+        self.actors[actor_id] = info
+        if not detached:
+            self._conn_owned_actors.setdefault(conn, set()).add(actor_id)
+        await self._schedule_actor(info)
+        return {"actor_id": actor_id, "existing": False}
+
+    async def _schedule_actor(self, info: ActorInfo):
+        demand = ResourceSet(info.resources)
+        views = [n.view() for n in self.nodes.values()]
+        node_id = hybrid_policy(
+            demand,
+            views,
+            spread_threshold=_config.scheduler_spread_threshold,
+            top_k_fraction=_config.scheduler_top_k_fraction,
+        )
+        if node_id is None:
+            # queue until resources free up: retry on next resource report
+            asyncio.get_running_loop().call_later(
+                0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
+            )
+            return
+        node = self.nodes[node_id]
+        info.node_id = node_id
+        # optimistic deduction so back-to-back placements don't double-book the
+        # node before its next resource report
+        node.available = node.available.subtract(demand)
+        try:
+            await node.conn.call(
+                "create_actor_worker",
+                actor_id=info.actor_id,
+                spec_blob=info.spec_blob,
+                resources=info.resources,
+                timeout=_config.gcs_rpc_timeout_s,
+            )
+        except (rpc.RpcError, rpc.ConnectionLost):
+            # stale view or raylet race — requeue, do NOT burn a restart
+            node.available = node.available.add(demand)
+            info.node_id = None
+            asyncio.get_running_loop().call_later(
+                0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
+            )
+
+    async def _retry_schedule(self, info: ActorInfo):
+        if info.state in (PENDING, RESTARTING):
+            await self._schedule_actor(info)
+
+    async def handle_actor_ready(self, conn, actor_id, address, node_id):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info.state = ALIVE
+        info.address = address
+        info.node_id = node_id
+        await self.publish("actor", info.public())
+        return True
+
+    async def handle_actor_failed(self, conn, actor_id, reason):
+        info = self.actors.get(actor_id)
+        if info and info.state != DEAD:
+            await self._on_actor_failure(info, reason)
+        return True
+
+    async def _on_actor_failure(self, info: ActorInfo, reason: str):
+        if info.restarts_left != 0 and info.state != DEAD:
+            if info.restarts_left > 0:
+                info.restarts_left -= 1
+            info.num_restarts += 1
+            info.state = RESTARTING
+            info.address = None
+            await self.publish("actor", info.public())
+            await asyncio.sleep(_config.actor_restart_backoff_s)
+            await self._schedule_actor(info)
+        else:
+            await self._mark_actor_dead(info, reason)
+
+    async def _mark_actor_dead(self, info: ActorInfo, reason: str):
+        info.state = DEAD
+        info.death_reason = reason
+        info.address = None
+        if info.name and self.named_actors.get((info.namespace, info.name)) == info.actor_id:
+            del self.named_actors[(info.namespace, info.name)]
+        await self.publish("actor", info.public())
+
+    async def handle_get_actor(self, conn, actor_id, wait_alive=False,
+                               wait_timeout=30.0):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        if wait_alive and info.state in (PENDING, RESTARTING):
+            deadline = time.monotonic() + wait_timeout
+            while info.state in (PENDING, RESTARTING) and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+        return info.public()
+
+    def handle_get_named_actor(self, conn, name, namespace="default"):
+        actor_id = self.named_actors.get((namespace, name))
+        if actor_id is None:
+            return None
+        return self.actors[actor_id].public()
+
+    def handle_list_actors(self, conn):
+        return [a.public() for a in self.actors.values()]
+
+    async def handle_kill_actor(self, conn, actor_id, no_restart=True):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        if no_restart:
+            info.restarts_left = 0
+        node = self.nodes.get(info.node_id) if info.node_id else None
+        if node and node.alive and info.address:
+            try:
+                await node.conn.call("kill_actor_worker", actor_id=actor_id, timeout=5)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+        if no_restart:
+            await self._mark_actor_dead(info, "killed via ray_tpu.kill")
+        return True
+
+    # --------------------------------------------------- placement groups
+    async def handle_create_placement_group(
+        self, conn, pg_id, bundles, strategy, detached=False, create_timeout=30.0
+    ):
+        info = PlacementGroupInfo(
+            pg_id=pg_id,
+            bundles=bundles,
+            strategy=strategy,
+            creator_conn=conn,
+            detached=detached,
+        )
+        self.placement_groups[pg_id] = info
+        if not detached:
+            self._conn_owned_pgs.setdefault(conn, set()).add(pg_id)
+        deadline = time.monotonic() + create_timeout
+        while time.monotonic() < deadline:
+            placed = await self._try_place_pg(info)
+            if placed:
+                return {"state": "CREATED", "placement": info.placement}
+            await asyncio.sleep(0.1)
+        return {"state": "PENDING", "placement": None}
+
+    async def _try_place_pg(self, info: PlacementGroupInfo) -> bool:
+        views = [n.view() for n in self.nodes.values()]
+        demands = [ResourceSet(b) for b in info.bundles]
+        placement = pack_bundles(demands, views, info.strategy)
+        if placement is None:
+            return False
+        # reserve on each node; roll back on partial failure
+        reserved = []
+        for idx, node_id in enumerate(placement):
+            node = self.nodes[node_id]
+            try:
+                ok = await node.conn.call(
+                    "reserve_bundle",
+                    pg_id=info.pg_id,
+                    bundle_index=idx,
+                    resources=info.bundles[idx],
+                    timeout=10,
+                )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                ok = False
+            if not ok:
+                for ridx, rnode_id in reserved:
+                    rnode = self.nodes.get(rnode_id)
+                    if rnode and rnode.alive:
+                        try:
+                            await rnode.conn.call(
+                                "release_bundle", pg_id=info.pg_id,
+                                bundle_index=ridx, timeout=10,
+                            )
+                        except (rpc.RpcError, rpc.ConnectionLost):
+                            pass
+                return False
+            reserved.append((idx, node_id))
+        info.placement = placement
+        info.state = "CREATED"
+        await self.publish("pg", {"pg_id": info.pg_id, "state": "CREATED"})
+        return True
+
+    async def handle_remove_placement_group(self, conn, pg_id):
+        info = self.placement_groups.pop(pg_id, None)
+        if info is None:
+            return False
+        if info.placement:
+            for idx, node_id in enumerate(info.placement):
+                node = self.nodes.get(node_id)
+                if node and node.alive:
+                    try:
+                        await node.conn.call(
+                            "release_bundle", pg_id=pg_id, bundle_index=idx,
+                            timeout=10,
+                        )
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
+        return True
+
+    def handle_get_placement_group(self, conn, pg_id):
+        info = self.placement_groups.get(pg_id)
+        if info is None:
+            return None
+        return {
+            "pg_id": info.pg_id,
+            "state": info.state,
+            "placement": info.placement,
+            "bundles": info.bundles,
+            "strategy": info.strategy,
+        }
+
+    # --------------------------------------------------------- disconnects
+    async def on_disconnection(self, conn):
+        # driver gone → tear down its non-detached actors and PGs
+        for actor_id in self._conn_owned_actors.pop(conn, set()):
+            info = self.actors.get(actor_id)
+            if info and info.state != DEAD:
+                info.restarts_left = 0
+                await self.handle_kill_actor(conn, actor_id, no_restart=True)
+        for pg_id in self._conn_owned_pgs.pop(conn, set()):
+            await self.handle_remove_placement_group(conn, pg_id)
+        # raylet connection drop → node dead (faster than health check timeout)
+        node_id = getattr(conn, "node_id", None)
+        if node_id and node_id in self.nodes:
+            node = self.nodes[node_id]
+            if node.alive and node.conn is conn:
+                await self._on_node_dead(node, "connection lost")
+
+
+def main():
+    """GCS process entrypoint: ray_tpu-gcs --port N"""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        gcs = GcsServer(host=args.host, port=args.port)
+        addr = await gcs.start()
+        print(f"GCS_ADDRESS={addr}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,243 @@
+"""Plasma-equivalent node object store over /dev/shm tmpfs files.
+
+Parity: src/ray/object_manager/plasma/ — an immutable shared-memory object
+store per node, zero-copy reads, create→seal lifecycle, eviction of
+unreferenced objects, spill-to-disk hooks. Design differences from plasma,
+chosen deliberately:
+
+- one tmpfs file per object instead of one dlmalloc arena + fd passing: the
+  kernel's tmpfs is the allocator; "fd passing" is just open(2) by name, which
+  removes the store daemon from the read path entirely. An mmap'd object stays
+  readable after eviction-unlink (POSIX semantics) so readers never race the
+  evictor.
+- seal = atomic rename (".b" building suffix dropped), so visibility is atomic
+  without locks.
+
+The per-node capacity ledger + LRU eviction + pinning live in the raylet
+(ObjectDirectory below); workers/drivers use ShmClient for create/get.
+
+A C++ implementation of the same layout (ops/_native) can slot in under the
+same interface; the data plane here is already zero-copy so the win would be
+in directory/eviction CPU, not bandwidth.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+
+_SHM_ROOT = "/dev/shm"
+
+
+def session_dir(session: str) -> str:
+    base = _SHM_ROOT if os.path.isdir(_SHM_ROOT) else "/tmp"
+    return os.path.join(base, f"ray_tpu_{session}")
+
+
+class ShmBuffer:
+    """A sealed object's mapped memory (context-managed, zero-copy)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+        size = os.fstat(self._f.fileno()).st_size
+        self._mm = mmap.mmap(self._f.fileno(), size, prot=mmap.PROT_READ)
+        self.buffer = memoryview(self._mm)
+
+    def close(self):
+        # NB: numpy views over self.buffer keep the mapping alive via refcount;
+        # release only when the consumer drops them.
+        try:
+            self.buffer.release()
+            self._mm.close()
+            self._f.close()
+        except BufferError:
+            pass  # still referenced — the mapping lives until views drop
+
+
+class ShmClient:
+    """Create/read objects in a node's shm directory (used by every process
+    on the node; no daemon round-trip on the data path)."""
+
+    def __init__(self, session: str):
+        self.dir = session_dir(session)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, oid: ObjectID) -> str:
+        return os.path.join(self.dir, oid.hex())
+
+    def create(self, oid: ObjectID, nbytes: int) -> Tuple[mmap.mmap, "open"]:
+        """Returns a writable mapping for the building object."""
+        path = self._path(oid) + ".b"
+        f = open(path, "w+b")
+        f.truncate(max(nbytes, 1))
+        mm = mmap.mmap(f.fileno(), max(nbytes, 1))
+        return mm, f
+
+    def seal(self, oid: ObjectID, mm: mmap.mmap, f) -> int:
+        mm.flush()
+        size = os.fstat(f.fileno()).st_size
+        mm.close()
+        f.close()
+        os.rename(self._path(oid) + ".b", self._path(oid))
+        return size
+
+    def put_bytes(self, oid: ObjectID, data) -> int:
+        """Convenience: create+write+seal in one call. data: bytes-like."""
+        mm, f = self.create(oid, len(data))
+        mm[: len(data)] = data
+        return self.seal(oid, mm, f)
+
+    def get(self, oid: ObjectID) -> Optional[ShmBuffer]:
+        try:
+            return ShmBuffer(self._path(oid))
+        except FileNotFoundError:
+            return None
+
+    def contains(self, oid: ObjectID) -> bool:
+        return os.path.exists(self._path(oid))
+
+    def size_of(self, oid: ObjectID) -> Optional[int]:
+        try:
+            return os.path.getsize(self._path(oid))
+        except FileNotFoundError:
+            return None
+
+    def delete(self, oid: ObjectID) -> None:
+        try:
+            os.unlink(self._path(oid))
+        except FileNotFoundError:
+            pass
+
+    def destroy(self):
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+@dataclass
+class _Entry:
+    nbytes: int
+    created_at: float
+    last_access: float
+    pins: int = 0
+
+
+class ObjectDirectory:
+    """Raylet-side ledger: which objects exist locally, capacity accounting,
+    LRU eviction of unpinned objects, spill hook.
+
+    Parity: plasma's ObjectLifecycleManager + EvictionPolicy
+    (object_lifecycle_manager.h, eviction_policy.h).
+    """
+
+    def __init__(self, client: ShmClient, capacity_bytes: int,
+                 spill_dir: Optional[str] = None):
+        self.client = client
+        self.capacity = capacity_bytes
+        self.used = 0
+        self.entries: Dict[ObjectID, _Entry] = {}
+        self.spill_dir = spill_dir
+        self.spilled: Dict[ObjectID, str] = {}
+        self._lock = threading.Lock()
+
+    def add(self, oid: ObjectID, nbytes: int):
+        with self._lock:
+            if oid in self.entries:
+                return
+            now = time.monotonic()
+            self.entries[oid] = _Entry(nbytes, now, now)
+            self.used += nbytes
+            if self.used > self.capacity:
+                self._evict_locked(self.used - self.capacity)
+
+    def touch(self, oid: ObjectID):
+        e = self.entries.get(oid)
+        if e:
+            e.last_access = time.monotonic()
+
+    def pin(self, oid: ObjectID):
+        with self._lock:
+            e = self.entries.get(oid)
+            if e:
+                e.pins += 1
+
+    def unpin(self, oid: ObjectID):
+        with self._lock:
+            e = self.entries.get(oid)
+            if e and e.pins > 0:
+                e.pins -= 1
+
+    def ensure_capacity(self, nbytes: int) -> bool:
+        with self._lock:
+            free = self.capacity - self.used
+            if free >= nbytes:
+                return True
+            return self._evict_locked(nbytes - free)
+
+    def delete(self, oid: ObjectID):
+        with self._lock:
+            e = self.entries.pop(oid, None)
+            if e:
+                self.used -= e.nbytes
+            self.client.delete(oid)
+            path = self.spilled.pop(oid, None)
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def _evict_locked(self, need: int) -> bool:
+        """LRU-evict unpinned objects (spilling them first when configured)."""
+        victims = sorted(
+            (o for o, e in self.entries.items() if e.pins == 0),
+            key=lambda o: self.entries[o].last_access,
+        )
+        freed = 0
+        for oid in victims:
+            if freed >= need:
+                break
+            e = self.entries.pop(oid)
+            if self.spill_dir and oid not in self.spilled:
+                self._spill(oid)
+            self.client.delete(oid)
+            self.used -= e.nbytes
+            freed += e.nbytes
+        return freed >= need
+
+    def _spill(self, oid: ObjectID):
+        buf = self.client.get(oid)
+        if buf is None:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            f.write(buf.buffer)
+        buf.close()
+        self.spilled[oid] = path
+
+    def restore(self, oid: ObjectID) -> bool:
+        """Bring a spilled object back into shm."""
+        path = self.spilled.get(oid)
+        if path is None or not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            data = f.read()
+        if not self.ensure_capacity(len(data)):
+            return False
+        self.client.put_bytes(oid, data)
+        self.add(oid, len(data))
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "num_objects": len(self.entries),
+            "used_bytes": self.used,
+            "capacity_bytes": self.capacity,
+            "num_spilled": len(self.spilled),
+        }

@@ -1,0 +1,489 @@
+"""Raylet: per-node daemon — local scheduler, worker pool, object plane.
+
+Parity: src/ray/raylet/node_manager.h:117 (NodeManager implements the node RPC
+service and the resource reporter), local_task_manager.cc (dispatch + spillback),
+plasma store runner (here: shm_store.ObjectDirectory), agent manager.
+
+Leases: owners request a worker lease for a resource demand (§3.2 of SURVEY);
+the raylet queues the request, grants (worker address) when resources + a
+worker are available, or replies with a spillback target from the gossiped
+cluster view.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import _config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store.shm_store import ObjectDirectory, ShmClient
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.core.scheduling_policy import NodeView, hybrid_policy
+from ray_tpu.core.raylet.worker_pool import (
+    ACTOR,
+    DEAD,
+    IDLE,
+    LEASED,
+    WorkerHandle,
+    WorkerPool,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class LeaseRequest:
+    lease_id: str
+    demand: ResourceSet
+    future: asyncio.Future
+    queued_at: float = field(default_factory=time.monotonic)
+    allow_spillback: bool = True
+    # set for placement-group tasks: consume the bundle's reservation instead
+    # of node-level availability (the bundle already holds the resources)
+    pg_id: Optional[bytes] = None
+    bundle_index: int = -1
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: str,
+        session: str,
+        node_id: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        object_store_memory_mb: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        worker_env: Optional[dict] = None,
+    ):
+        self.node_id = node_id or uuid.uuid4().hex[:16]
+        self.session = session
+        self.gcs_address = gcs_address
+        self.server = rpc.RpcServer(self, host=host, port=port)
+        self.total = ResourceSet(resources or {})
+        self.available = ResourceSet(resources or {})
+        self.shm = ShmClient(session)
+        cap_mb = object_store_memory_mb or _config.object_store_memory_mb
+        self.directory = ObjectDirectory(
+            self.shm, cap_mb * 1024 * 1024,
+            spill_dir=spill_dir or _config.object_spilling_dir or None,
+        )
+        self.worker_env = worker_env or {}
+        self.pool: Optional[WorkerPool] = None
+        self.gcs: Optional[rpc.Connection] = None
+        self.pending_leases: List[LeaseRequest] = []
+        self.active_leases: Dict[str, Tuple[ResourceSet, WorkerHandle, tuple]] = {}
+        self.cluster_view: Dict[str, dict] = {}
+        self.bundles: Dict[Tuple[bytes, int], ResourceSet] = {}
+        self.bundle_free: Dict[Tuple[bytes, int], ResourceSet] = {}
+        self._bg: List[asyncio.Task] = []
+        self._peer_conns: Dict[str, rpc.Connection] = {}
+        self._actor_specs: Dict[bytes, bytes] = {}
+        self._actor_resources: Dict[bytes, ResourceSet] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self):
+        await self.server.start()
+        self.pool = WorkerPool(
+            self.server.address, self.gcs_address, self.session, self.node_id,
+            env=self.worker_env,
+        )
+        self.pool.on_worker_death = self._on_worker_death
+        self.gcs = await rpc.connect(
+            self.gcs_address, handler=self, name=f"raylet-{self.node_id}->gcs"
+        )
+        await self.gcs.call(
+            "register_node",
+            node_id=self.node_id,
+            address=self.server.address,
+            session=self.session,
+            resources=self.total.to_dict(),
+            labels=self._labels(),
+        )
+        self._bg.append(asyncio.create_task(self._report_loop()))
+        self._bg.append(asyncio.create_task(self._poll_loop()))
+        if _config.enable_worker_prestart:
+            n = min(2, int(self.total.get("CPU")) or 1)
+            for _ in range(n):
+                self.pool.start_worker()
+        logger.info(
+            "raylet %s on %s resources=%s",
+            self.node_id, self.server.address, self.total.to_dict(),
+        )
+        return self.server.address
+
+    def _labels(self) -> Dict[str, str]:
+        labels = {}
+        slice_name = os.environ.get("TPU_NAME") or os.environ.get("TPU_WORKER_ID")
+        if slice_name is not None:
+            labels["tpu-slice"] = os.environ.get("TPU_NAME", "local-slice")
+        return labels
+
+    async def close(self):
+        for t in self._bg:
+            t.cancel()
+        if self.pool:
+            self.pool.shutdown()
+        if self.gcs:
+            await self.gcs.close()
+        await self.server.close()
+
+    async def _report_loop(self):
+        period = _config.health_check_period_ms / 1000
+        while True:
+            try:
+                await self.gcs.call(
+                    "resource_report",
+                    node_id=self.node_id,
+                    available=self.available.to_dict(),
+                )
+                self.cluster_view = await self.gcs.call("get_resource_view")
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+            await asyncio.sleep(period)
+
+    async def _poll_loop(self):
+        while True:
+            try:
+                await self.pool.poll_deaths()
+                await self._dispatch()
+            except Exception:  # noqa: BLE001 - the loop must survive anything
+                logger.exception("raylet poll loop error")
+            await asyncio.sleep(0.05)
+
+    # ----------------------------------------------------------- scheduling
+    async def handle_request_lease(
+        self, conn, resources, allow_spillback=True, pg_id=None, bundle_index=-1,
+    ):
+        """Owner asks for a worker lease. Replies:
+        {granted: worker_addr, lease_id} | {spillback: raylet_addr} |
+        {infeasible: True} (never schedulable here or anywhere known)."""
+        demand = ResourceSet(resources)
+        if pg_id is not None:
+            if not any(k[0] == pg_id for k in self.bundles):
+                return {"infeasible": True, "reason": "bundle not on this node"}
+            if bundle_index >= 0 and (pg_id, bundle_index) not in self.bundles:
+                return {"infeasible": True, "reason": "bundle not on this node"}
+        # NB: a demand this node can never fit still QUEUES — the gossiped
+        # cluster view may be seconds stale; _dispatch retries spillback each
+        # tick and only declares infeasibility after the lease timeout
+        # (reference: infeasible tasks stay queued, cluster_task_manager).
+        lease = LeaseRequest(
+            lease_id=uuid.uuid4().hex,
+            demand=demand,
+            future=asyncio.get_running_loop().create_future(),
+            allow_spillback=allow_spillback and pg_id is None,
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+        )
+        self.pending_leases.append(lease)
+        await self._dispatch()
+        return await lease.future
+
+    def _acquire_for(self, lease: LeaseRequest) -> Optional[object]:
+        """Try to take resources for a lease. Returns an opaque release token
+        or None. PG leases draw from the bundle's reservation; plain leases
+        from node availability."""
+        if lease.pg_id is not None:
+            keys = (
+                [(lease.pg_id, lease.bundle_index)]
+                if lease.bundle_index >= 0
+                else sorted(k for k in self.bundle_free if k[0] == lease.pg_id)
+            )
+            for key in keys:
+                free = self.bundle_free.get(key)
+                if free is not None and free.fits(lease.demand):
+                    self.bundle_free[key] = free.subtract(lease.demand)
+                    return ("bundle", key)
+            return None
+        if self.available.fits(lease.demand):
+            self.available = self.available.subtract(lease.demand)
+            return ("node", None)
+        return None
+
+    def _release_token(self, token, demand: ResourceSet):
+        kind, key = token
+        if kind == "bundle":
+            free = self.bundle_free.get(key)
+            if free is not None:
+                self.bundle_free[key] = free.add(demand)
+        else:
+            self.available = self.available.add(demand)
+
+    def _spillback_target(self, demand: ResourceSet) -> Optional[str]:
+        views = []
+        for nid, v in self.cluster_view.items():
+            if nid == self.node_id or not v.get("alive"):
+                continue
+            views.append(
+                NodeView(
+                    node_id=nid,
+                    total=ResourceSet(v["total"]),
+                    available=ResourceSet(v["available"]),
+                )
+            )
+        pick = hybrid_policy(demand, views)
+        if pick is None:
+            # any node that could EVER fit it
+            for v in views:
+                if v.total.fits(demand):
+                    return self.cluster_view[v.node_id]["address"]
+            return None
+        return self.cluster_view[pick]["address"]
+
+    async def _dispatch(self):
+        """One scan over queued leases (parity:
+        LocalTaskManager::DispatchScheduledTasksToWorkers). Leases this node
+        can never fit resolve via spillback/timeout without blocking others;
+        fit-able leases grant FIFO as resources + idle workers allow."""
+        now = time.monotonic()
+        for lease in list(self.pending_leases):
+            if lease.future.done():
+                self.pending_leases.remove(lease)
+                continue
+            never_fits_here = lease.pg_id is None and not self.total.fits(
+                lease.demand
+            )
+            if never_fits_here:
+                if lease.allow_spillback:
+                    target = self._spillback_target(lease.demand)
+                    if target:
+                        self.pending_leases.remove(lease)
+                        lease.future.set_result({"spillback": target})
+                        continue
+                if now - lease.queued_at > _config.worker_lease_timeout_ms / 1000:
+                    self.pending_leases.remove(lease)
+                    lease.future.set_result(
+                        {"infeasible": True, "reason": "no node can fit demand"}
+                    )
+                continue
+            idle = self.pool.idle_workers()
+            if not idle:
+                starting = sum(
+                    1 for w in self.pool.workers.values() if w.state == "STARTING"
+                )
+                alive = sum(
+                    1 for w in self.pool.workers.values() if w.state != DEAD
+                )
+                # spawn at most one per tick, only when the pipeline of
+                # starting workers doesn't already cover the queue
+                if starting < len(self.pending_leases) and alive < self._worker_cap():
+                    self.pool.start_worker()
+                continue
+            token = self._acquire_for(lease)
+            if token is None:
+                # resources busy: after a grace period, offload to a peer
+                if lease.allow_spillback and now - lease.queued_at >= 0.5:
+                    target = self._spillback_target(lease.demand)
+                    if target:
+                        self.pending_leases.remove(lease)
+                        lease.future.set_result({"spillback": target})
+                continue
+            worker = idle[0]
+            worker.state = LEASED
+            worker.lease_id = lease.lease_id
+            self.active_leases[lease.lease_id] = (lease.demand, worker, token)
+            self.pending_leases.remove(lease)
+            lease.future.set_result(
+                {"granted": worker.address, "lease_id": lease.lease_id,
+                 "worker_id": worker.worker_id}
+            )
+            logger.debug("lease %s granted -> %s", lease.lease_id[:8], worker.address)
+
+    def _worker_cap(self) -> int:
+        cap = _config.num_workers_soft_limit
+        if cap <= 0:
+            cap = max(4, int(self.total.get("CPU")) * 2)
+        return cap
+
+    def handle_return_lease(self, conn, lease_id):
+        entry = self.active_leases.pop(lease_id, None)
+        if entry is None:
+            return False
+        demand, worker, token = entry
+        self._release_token(token, demand)
+        if worker.state == LEASED:
+            worker.state = IDLE
+            worker.lease_id = None
+        return True
+
+    # ------------------------------------------------------------- workers
+    def handle_register_worker(self, conn, startup_token, worker_id, address):
+        handle = self.pool.on_register(startup_token, worker_id, address, conn)
+        logger.info(
+            "worker registered token=%s addr=%s ok=%s",
+            startup_token, address, handle is not None,
+        )
+        if handle is None:
+            return None
+        reply = {
+            "node_id": self.node_id,
+            "session": self.session,
+            "actor_id": handle.actor_id,
+        }
+        if handle.actor_id is not None:
+            reply["actor_spec"] = self._actor_specs.get(handle.actor_id)
+        return reply
+
+    async def _on_worker_death(self, handle: WorkerHandle):
+        if handle.lease_id:
+            self.handle_return_lease(None, handle.lease_id)
+        if handle.actor_id is not None:
+            demand = self._actor_resources.pop(handle.actor_id, None)
+            if demand is not None:
+                self.available = self.available.add(demand)
+            try:
+                await self.gcs.call(
+                    "actor_failed",
+                    actor_id=handle.actor_id,
+                    reason=f"worker process died (exit {handle.proc.returncode})",
+                )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+
+    # -------------------------------------------------------------- actors
+    async def handle_create_actor_worker(self, conn, actor_id, spec_blob, resources):
+        demand = ResourceSet(resources)
+        if not self.available.fits(demand):
+            # GCS picked us from a stale view; let it retry
+            raise RuntimeError("resources no longer available")
+        self.available = self.available.subtract(demand)
+        self._actor_specs[actor_id] = spec_blob
+        self._actor_resources[actor_id] = demand
+        handle = self.pool.start_worker(actor_id=actor_id)
+        handle.state = ACTOR
+        return True
+
+    async def handle_kill_actor_worker(self, conn, actor_id):
+        handle = self.pool.get_actor_worker(actor_id)
+        if handle:
+            self.pool.kill_worker(handle)
+            return True
+        return False
+
+    # ---------------------------------------------------- placement groups
+    def handle_reserve_bundle(self, conn, pg_id, bundle_index, resources):
+        demand = ResourceSet(resources)
+        if not self.available.fits(demand):
+            return False
+        self.available = self.available.subtract(demand)
+        self.bundles[(pg_id, bundle_index)] = demand
+        self.bundle_free[(pg_id, bundle_index)] = demand
+        return True
+
+    def handle_release_bundle(self, conn, pg_id, bundle_index):
+        demand = self.bundles.pop((pg_id, bundle_index), None)
+        self.bundle_free.pop((pg_id, bundle_index), None)
+        if demand is not None:
+            self.available = self.available.add(demand)
+        return True
+
+    # ------------------------------------------------------------- objects
+    def handle_object_added(self, conn, oid_hex, nbytes):
+        self.directory.add(ObjectID.from_hex(oid_hex), nbytes)
+        return True
+
+    def handle_free_objects(self, conn, oids_hex):
+        for h in oids_hex:
+            self.directory.delete(ObjectID.from_hex(h))
+        return True
+
+    async def handle_fetch_object(self, conn, oid_hex):
+        """Peer raylet (or local client) reads object bytes for transfer."""
+        oid = ObjectID.from_hex(oid_hex)
+        buf = self.shm.get(oid)
+        if buf is None:
+            if not self.directory.restore(oid):
+                return None
+            buf = self.shm.get(oid)
+            if buf is None:
+                return None
+        self.directory.touch(oid)
+        data = bytes(buf.buffer)
+        buf.close()
+        return data
+
+    async def handle_pull_object(self, conn, oid_hex, source_addr):
+        """Pull an object from a remote raylet into the local store (parity:
+        PullManager/PushManager chunked transfer — single-frame here)."""
+        oid = ObjectID.from_hex(oid_hex)
+        if self.shm.contains(oid):
+            return True
+        if self.directory.restore(oid):
+            return True
+        peer = self._peer_conns.get(source_addr)
+        if peer is None or peer.closed:
+            try:
+                peer = await rpc.connect(source_addr, handler=self, retries=3)
+            except rpc.ConnectionLost:
+                return False
+            self._peer_conns[source_addr] = peer
+        try:
+            data = await peer.call("fetch_object", oid_hex=oid_hex, timeout=60)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            return False
+        if data is None:
+            return False
+        self.directory.ensure_capacity(len(data))
+        self.shm.put_bytes(oid, data)
+        self.directory.add(oid, len(data))
+        return True
+
+    def handle_object_store_stats(self, conn):
+        return self.directory.stats()
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--object-store-memory-mb", type=int, default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import json
+
+    from ray_tpu.core.resources import node_resources
+
+    res = node_resources(
+        num_cpus=int(args.num_cpus) if args.num_cpus is not None else None,
+        num_tpus=int(args.num_tpus) if args.num_tpus is not None else None,
+        custom=json.loads(args.resources),
+        detect_tpus=args.num_tpus is None,
+    )
+
+    async def run():
+        raylet = Raylet(
+            gcs_address=args.gcs,
+            session=args.session,
+            node_id=args.node_id,
+            resources=res,
+            host=args.host,
+            port=args.port,
+            object_store_memory_mb=args.object_store_memory_mb,
+        )
+        addr = await raylet.start()
+        print(f"RAYLET_ADDRESS={addr}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Worker pool: spawn, track, and lease Python worker processes.
+
+Parity: src/ray/raylet/worker_pool.h:152 — process startup with a startup
+token, prestarting, idle tracking, dedicated actor workers, death detection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+STARTING, IDLE, LEASED, ACTOR, DEAD = "STARTING", "IDLE", "LEASED", "ACTOR", "DEAD"
+
+
+@dataclass
+class WorkerHandle:
+    startup_token: int
+    proc: subprocess.Popen
+    state: str = STARTING
+    worker_id: Optional[str] = None
+    address: Optional[str] = None    # worker's rpc server
+    conn: object = None              # raylet<->worker connection
+    actor_id: Optional[bytes] = None
+    lease_id: Optional[str] = None
+    started_at: float = field(default_factory=time.monotonic)
+
+
+class WorkerPool:
+    def __init__(self, raylet_address: str, gcs_address: str, session: str,
+                 node_id: str, env: Optional[dict] = None):
+        self.raylet_address = raylet_address
+        self.gcs_address = gcs_address
+        self.session = session
+        self.node_id = node_id
+        self.extra_env = env or {}
+        self._next_token = 0
+        self.workers: Dict[int, WorkerHandle] = {}
+        self._registered: asyncio.Event = asyncio.Event()
+        self.on_worker_death = None  # callback(handle)
+
+    def start_worker(self, actor_id: Optional[bytes] = None) -> WorkerHandle:
+        token = self._next_token
+        self._next_token += 1
+        env = {
+            **os.environ,
+            **self.extra_env,
+            "RAY_TPU_RAYLET_ADDRESS": self.raylet_address,
+            "RAY_TPU_GCS_ADDRESS": self.gcs_address,
+            "RAY_TPU_SESSION": self.session,
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_STARTUP_TOKEN": str(token),
+        }
+        # restore TPU plugin env for workers on TPU nodes (stripped from the
+        # raylet's own env — see cluster_backend.start_raylet)
+        preserved = os.environ.get("RAY_TPU_PRESERVED_TPU_ENV")
+        if preserved:
+            import json
+
+            env.update(json.loads(preserved))
+        log_dir = os.path.join("/tmp", "ray_tpu", self.session, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log = open(os.path.join(log_dir, f"worker-{self.node_id}-{token}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+        handle = WorkerHandle(startup_token=token, proc=proc)
+        if actor_id is not None:
+            handle.state = STARTING
+            handle.actor_id = actor_id
+        self.workers[token] = handle
+        logger.info("started worker token=%d pid=%d", token, proc.pid)
+        return handle
+
+    def on_register(self, startup_token: int, worker_id: str, address: str, conn):
+        handle = self.workers.get(startup_token)
+        if handle is None:
+            return None
+        handle.worker_id = worker_id
+        handle.address = address
+        handle.conn = conn
+        if handle.state == STARTING and handle.actor_id is None:
+            handle.state = IDLE
+        return handle
+
+    def idle_workers(self) -> List[WorkerHandle]:
+        return [w for w in self.workers.values() if w.state == IDLE]
+
+    def get_by_worker_id(self, worker_id: str) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.worker_id == worker_id:
+                return w
+        return None
+
+    def get_actor_worker(self, actor_id: bytes) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.actor_id == actor_id and w.state != DEAD:
+                return w
+        return None
+
+    async def poll_deaths(self):
+        """Detect worker process exits (reference: raylet socket monitoring)."""
+        for w in list(self.workers.values()):
+            if w.state != DEAD and w.proc.poll() is not None:
+                w.state = DEAD
+                logger.warning(
+                    "worker pid=%d token=%d died (exit %s)",
+                    w.proc.pid, w.startup_token, w.proc.returncode,
+                )
+                if self.on_worker_death:
+                    res = self.on_worker_death(w)
+                    if asyncio.iscoroutine(res):
+                        await res
+
+    def kill_worker(self, handle: WorkerHandle, force: bool = True):
+        try:
+            handle.proc.kill() if force else handle.proc.terminate()
+        except ProcessLookupError:
+            pass
+        handle.state = DEAD
+
+    def shutdown(self):
+        for w in self.workers.values():
+            try:
+                w.proc.kill()
+            except ProcessLookupError:
+                pass
+        for w in self.workers.values():
+            try:
+                w.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                pass

@@ -1,0 +1,306 @@
+"""Asyncio RPC: length-prefixed pickled frames over TCP, with server push.
+
+Role parity: src/ray/rpc/ (GrpcServer/ClientCall). A fresh design rather than
+gRPC: the control plane is Python end-to-end here, so a compact asyncio framing
+with pipelined request/response and subscription push keeps latency low without
+protobuf codegen. The wire format is private to the framework.
+
+Frame: [8-byte little-endian length][pickled (msg_type, msg_id, method, payload)]
+msg_type: 0=request, 1=response, 2=error, 3=push (server-initiated, msg_id is
+subscription id).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import threading
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
+_MAX_FRAME = 1 << 34  # 16 GiB guard
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteCallError(RpcError):
+    """The handler on the far side raised; carries its traceback string."""
+
+    def __init__(self, method, cls_name, tb):
+        self.method, self.cls_name, self.tb = method, cls_name, tb
+        super().__init__(f"rpc {method} failed with {cls_name}\n{tb}")
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(8)
+    n = int.from_bytes(header, "little")
+    if n > _MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    data = await reader.readexactly(n)
+    return pickle.loads(data)
+
+
+def _frame(obj) -> bytes:
+    data = pickle.dumps(obj, protocol=5)
+    return len(data).to_bytes(8, "little") + data
+
+
+class Connection:
+    """One bidirectional connection: concurrent requests + pushes both ways."""
+
+    def __init__(self, reader, writer, handler=None, on_close=None, name=""):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler  # object with async handle_<method>(**payload)
+        self.on_close = on_close
+        self.name = name
+        self._next_id = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._push_handlers: Dict[str, Callable] = {}
+        self._closed = False
+        self._writer_lock = asyncio.Lock()
+        self._reader_task: Optional[asyncio.Task] = None
+        # strong refs to in-flight dispatch tasks (create_task results are
+        # otherwise GC-able mid-flight — a classic asyncio footgun)
+        self._bg_tasks: set = set()
+
+    def _spawn(self, coro):
+        t = asyncio.create_task(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
+
+    def start(self):
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    @property
+    def peername(self) -> str:
+        try:
+            return str(self.writer.get_extra_info("peername"))
+        except Exception:  # noqa: BLE001
+            return "?"
+
+    async def call(self, method: str, timeout: Optional[float] = None, **payload):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        msg_id = next(self._next_id)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        await self._send((REQUEST, msg_id, method, payload))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError as e:
+            raise RpcError(f"rpc {method} timed out after {timeout}s") from e
+        finally:
+            self._pending.pop(msg_id, None)
+
+    async def notify(self, method: str, **payload):
+        """One-way message (no response expected)."""
+        await self._send((REQUEST, 0, method, payload))
+
+    async def push(self, channel: str, payload: Any):
+        await self._send((PUSH, 0, channel, payload))
+
+    def on_push(self, channel: str, fn: Callable[[Any], Any]):
+        self._push_handlers[channel] = fn
+
+    async def _send(self, msg):
+        try:
+            async with self._writer_lock:
+                self.writer.write(_frame(msg))
+                await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, RuntimeError) as e:
+            await self._handle_close()
+            raise ConnectionLost(str(e)) from e
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg_type, msg_id, method, payload = await _read_frame(self.reader)
+                if msg_type == REQUEST:
+                    self._spawn(self._dispatch(msg_id, method, payload))
+                elif msg_type == RESPONSE:
+                    fut = self._pending.get(msg_id)
+                    if fut and not fut.done():
+                        fut.set_result(payload)
+                elif msg_type == ERROR:
+                    fut = self._pending.get(msg_id)
+                    if fut and not fut.done():
+                        fut.set_exception(
+                            RemoteCallError(method, payload["cls"], payload["tb"])
+                        )
+                elif msg_type == PUSH:
+                    fn = self._push_handlers.get(method)
+                    if fn:
+                        res = fn(payload)
+                        if asyncio.iscoroutine(res):
+                            self._spawn(res)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+            asyncio.CancelledError,
+        ):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("rpc read loop error on %s", self.name)
+        finally:
+            await self._handle_close()
+
+    async def _dispatch(self, msg_id, method, payload):
+        try:
+            fn = getattr(self.handler, f"handle_{method}", None)
+            if fn is None:
+                raise RpcError(f"no handler for {method!r} on {self.handler}")
+            result = fn(self, **payload)
+            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+                result = await result
+            if msg_id:
+                await self._send((RESPONSE, msg_id, method, result))
+        except ConnectionLost:
+            pass
+        except Exception as e:  # noqa: BLE001
+            if msg_id:
+                try:
+                    await self._send(
+                        (
+                            ERROR,
+                            msg_id,
+                            method,
+                            {"cls": type(e).__name__, "tb": traceback.format_exc()},
+                        )
+                    )
+                except ConnectionLost:
+                    pass
+            else:
+                logger.exception("error in one-way handler %s", method)
+
+    async def _handle_close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self.on_close:
+            res = self.on_close(self)
+            if asyncio.iscoroutine(res):
+                await res
+
+    async def close(self):
+        if self._reader_task:
+            self._reader_task.cancel()
+        await self._handle_close()
+
+    @property
+    def closed(self):
+        return self._closed
+
+
+class RpcServer:
+    """TCP server dispatching to a handler object (async handle_<method>)."""
+
+    def __init__(self, handler, host="127.0.0.1", port=0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set = set()
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._on_connect, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def _on_connect(self, reader, writer):
+        conn = Connection(
+            reader,
+            writer,
+            handler=self.handler,
+            on_close=self._on_conn_close,
+            name=f"server<-{writer.get_extra_info('peername')}",
+        ).start()
+        self.connections.add(conn)
+        cb = getattr(self.handler, "on_connection", None)
+        if cb:
+            res = cb(conn)
+            if asyncio.iscoroutine(res):
+                await res
+
+    def _on_conn_close(self, conn):
+        self.connections.discard(conn)
+        cb = getattr(self.handler, "on_disconnection", None)
+        if cb:
+            return cb(conn)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def close(self):
+        for conn in list(self.connections):
+            await conn.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def connect(
+    address: str, handler=None, name: str = "", retries: int = 30,
+    retry_delay: float = 0.1,
+) -> Connection:
+    host, port_s = address.rsplit(":", 1)
+    last_err = None
+    for _ in range(retries):
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port_s))
+            return Connection(reader, writer, handler=handler, name=name).start()
+        except (ConnectionRefusedError, OSError) as e:
+            last_err = e
+            await asyncio.sleep(retry_delay)
+    raise ConnectionLost(f"cannot connect to {address}: {last_err}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop thread (drivers/workers embed the RPC plane
+    next to user code, like the CoreWorker's io_service thread)."""
+
+    def __init__(self, name="ray-tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)

@@ -1,0 +1,137 @@
+"""Node-selection policies shared by the GCS (actor/PG scheduling) and raylets
+(task spillback).
+
+Parity: src/ray/raylet/scheduling/policy/ — hybrid top-k
+(hybrid_scheduling_policy.h:29-60: prefer packing onto low-utilization nodes to
+avoid cold starts, but spread once utilization crosses a threshold), spread,
+node-affinity. Same tradeoff implemented over our gossiped resource view.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.core.resources import ResourceSet
+
+
+@dataclass
+class NodeView:
+    node_id: str
+    total: ResourceSet
+    available: ResourceSet
+    alive: bool = True
+    labels: Dict[str, str] = None
+
+    def utilization(self) -> float:
+        return self.available.utilization(self.total)
+
+
+def feasible(nodes: Sequence[NodeView], demand: ResourceSet) -> List[NodeView]:
+    """Nodes whose TOTAL resources could ever satisfy the demand."""
+    return [n for n in nodes if n.alive and n.total.fits(demand)]
+
+
+def hybrid_policy(
+    demand: ResourceSet,
+    nodes: Sequence[NodeView],
+    local_node_id: Optional[str] = None,
+    spread_threshold: float = 0.5,
+    top_k_fraction: float = 0.2,
+    seed: Optional[int] = None,
+) -> Optional[str]:
+    """Pick a node for `demand`. Prefers the local node while its utilization
+    is under `spread_threshold`; otherwise scores all available nodes by
+    utilization (pack) and picks randomly among the top-k best to avoid
+    thundering herds. Returns None if nothing is available right now."""
+    avail = [n for n in nodes if n.alive and n.available.fits(demand)]
+    if not avail:
+        return None
+    if local_node_id is not None:
+        local = next((n for n in avail if n.node_id == local_node_id), None)
+        if local is not None and local.utilization() < spread_threshold:
+            return local.node_id
+    # score: utilization-then-id for determinism; sample from top-k
+    ranked = sorted(avail, key=lambda n: (n.utilization(), n.node_id))
+    k = max(1, int(len(ranked) * top_k_fraction))
+    rng = random.Random(seed)
+    return rng.choice(ranked[:k]).node_id
+
+
+def spread_policy(
+    demand: ResourceSet,
+    nodes: Sequence[NodeView],
+    rotation_counter: int = 0,
+) -> Optional[str]:
+    """Round-robin over available nodes (SPREAD scheduling strategy)."""
+    avail = sorted(
+        (n for n in nodes if n.alive and n.available.fits(demand)),
+        key=lambda n: n.node_id,
+    )
+    if not avail:
+        return None
+    return avail[rotation_counter % len(avail)].node_id
+
+
+def node_affinity_policy(
+    demand: ResourceSet, nodes: Sequence[NodeView], node_id: str, soft: bool
+) -> Optional[str]:
+    target = next((n for n in nodes if n.node_id == node_id), None)
+    if target and target.alive and target.available.fits(demand):
+        return node_id
+    if soft:
+        return hybrid_policy(demand, nodes)
+    return None
+
+
+def pack_bundles(
+    bundles: List[ResourceSet],
+    nodes: Sequence[NodeView],
+    strategy: str,
+) -> Optional[List[str]]:
+    """Placement-group bundle packing (bundle_scheduling_policy.cc analog).
+
+    Returns a node id per bundle, or None if infeasible. STRICT_PACK requires
+    one node for all bundles; STRICT_SPREAD requires distinct nodes; PACK/
+    SPREAD are best-effort versions. TPU-aware: PACK prefers nodes sharing a
+    `tpu-slice` label so co-packed bundles land on one ICI slice."""
+    alive = [n for n in nodes if n.alive]
+    if strategy in ("STRICT_PACK", "PACK"):
+        # try single node first (honoring slice grouping for ICI locality)
+        for n in sorted(alive, key=lambda n: ((n.labels or {}).get("tpu-slice", ""), n.utilization())):
+            remaining = n.available
+            ok = True
+            for b in bundles:
+                if not remaining.fits(b):
+                    ok = False
+                    break
+                remaining = remaining.subtract(b)
+            if ok:
+                return [n.node_id] * len(bundles)
+        if strategy == "STRICT_PACK":
+            return None
+    if strategy == "STRICT_SPREAD" and len(bundles) > len(alive):
+        return None
+    # greedy: place each bundle on the least-utilized node that fits,
+    # tracking per-node remaining capacity
+    remaining = {n.node_id: n.available for n in alive}
+    order = {n.node_id: n for n in alive}
+    placement: List[str] = []
+    used_nodes: set = set()
+    for b in bundles:
+        candidates = [
+            nid for nid, avail in remaining.items() if avail.fits(b)
+        ]
+        if strategy == "STRICT_SPREAD":
+            candidates = [c for c in candidates if c not in used_nodes]
+        if strategy == "SPREAD":
+            fresh = [c for c in candidates if c not in used_nodes]
+            candidates = fresh or candidates
+        if not candidates:
+            return None
+        pick = min(candidates, key=lambda nid: order[nid].utilization())
+        placement.append(pick)
+        used_nodes.add(pick)
+        remaining[pick] = remaining[pick].subtract(b)
+    return placement

@@ -1,0 +1,98 @@
+"""TaskSpec: the wire description of a task/actor-task/actor-creation.
+
+Parity: src/ray/common/task/task_spec.h + common.proto TaskSpec. Functions are
+content-addressed into the GCS function registry (sha of the cloudpickle
+blob), so a hot function crosses the wire once per cluster, not once per call
+(reference: python/ray/_private/function_manager.py export path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID
+from ray_tpu.core.refs import ObjectRef
+
+# arg encodings
+ARG_VALUE = 0   # small value, serialized inline
+ARG_REF = 1     # ObjectRef dependency
+
+
+def function_id(pickled_fn: bytes) -> bytes:
+    return hashlib.blake2b(pickled_fn, digest_size=16).digest()
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    fn_id: bytes                      # key into GCS function registry
+    args: List[Tuple[int, Any]]       # (ARG_VALUE, bytes) | (ARG_REF, ObjectRef)
+    kwargs: Dict[str, Tuple[int, Any]]
+    num_returns: int
+    resources: Dict[str, float]
+    owner_addr: str                   # rpc address of the owning worker
+    job_id: bytes = b""
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # actor fields
+    actor_id: Optional[ActorID] = None         # set for actor tasks
+    actor_method: Optional[str] = None
+    actor_seq_no: int = 0                      # per-caller ordering
+    is_actor_creation: bool = False
+    actor_options: Optional[dict] = None       # RemoteOptions fields for creation
+    scheduling_strategy: Any = None
+    placement_group_id: Any = None
+    placement_group_bundle_index: int = -1
+
+    def return_refs(self) -> List[ObjectRef]:
+        return [
+            ObjectRef(
+                ObjectID.for_task_return(self.task_id, i),
+                owner_addr=self.owner_addr,
+                task_id=self.task_id,
+            )
+            for i in range(max(1, self.num_returns))
+        ]
+
+    def dependencies(self) -> List[ObjectRef]:
+        deps = [a[1] for a in self.args if a[0] == ARG_REF]
+        deps += [a[1] for a in self.kwargs.values() if a[0] == ARG_REF]
+        return deps
+
+
+def encode_args(args, kwargs, put_fn, inline_limit: int = 100 * 1024):
+    """Encode call args: ObjectRefs pass by reference; values serialize inline
+    when small, else spill to the object store via put_fn(value)->ObjectRef
+    (reference behavior: direct_task_transport inlines small args)."""
+    def enc(v):
+        if isinstance(v, ObjectRef):
+            return (ARG_REF, v)
+        s = serialization.serialize(v)
+        if s.total_bytes() > inline_limit:
+            return (ARG_REF, put_fn(v))
+        return (ARG_VALUE, s.to_bytes())
+
+    return [enc(a) for a in args], {k: enc(v) for k, v in kwargs.items()}
+
+
+def decode_args(enc_args, enc_kwargs, get_fn):
+    """get_fn(list_of_refs) -> list_of_values (batched dependency fetch)."""
+    refs = [v for (t, v) in enc_args if t == ARG_REF]
+    refs += [v for (t, v) in enc_kwargs.values() if t == ARG_REF]
+    fetched = iter(get_fn(refs)) if refs else iter(())
+    resolved = {id(r): None for r in refs}
+    for r in refs:
+        resolved[id(r)] = next(fetched)
+
+    def dec(t, v):
+        if t == ARG_REF:
+            return resolved[id(v)]
+        return serialization.loads(v)
+
+    args = [dec(t, v) for (t, v) in enc_args]
+    kwargs = {k: dec(t, v) for k, (t, v) in enc_kwargs.items()}
+    return args, kwargs

@@ -1,0 +1,225 @@
+"""Worker process: executes tasks pushed by owners.
+
+Parity: CoreWorkerProcess::RunTaskExecutionLoop (core_worker_process.cc:63) +
+the Cython execute_task callback (_raylet.pyx:1318). The worker is also a full
+CoreWorker (it owns objects created by nested submissions). Actor workers keep
+per-owner sequence buffers so actor tasks execute in submission order
+(actor_scheduling_queue.h analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import os
+import threading
+import traceback
+from typing import Dict, Optional
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core import rpc, serialization, task_spec as ts
+from ray_tpu.core.config import _config
+from ray_tpu.core.core_worker import CoreWorker
+from ray_tpu.core.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerAgent(CoreWorker):
+    def __init__(self, gcs_address, raylet_address, session, node_id):
+        super().__init__(gcs_address, raylet_address, session, node_id, mode="worker")
+        self._exec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec"
+        )
+        # actor state
+        self.actor_instance = None
+        self.actor_id: Optional[bytes] = None
+        self._actor_ready = threading.Event()
+        self._actor_init_error: Optional[BaseException] = None
+
+    # -------------------------------------------------------- registration
+    def register_with_raylet(self, startup_token: int):
+        reply = self.io.run(
+            self.raylet.call(
+                "register_worker",
+                startup_token=startup_token,
+                worker_id=self.worker_id.hex(),
+                address=self.address,
+            )
+        )
+        if reply is None:
+            raise RuntimeError("raylet rejected registration")
+        if reply.get("actor_id") is not None:
+            self.actor_id = reply["actor_id"]
+            spec_blob = reply.get("actor_spec")
+            threading.Thread(
+                target=self._init_actor, args=(spec_blob,), daemon=True
+            ).start()
+        return reply
+
+    # --------------------------------------------------------------- tasks
+    async def handle_push_task(self, conn, spec_blob):
+        spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
+        logger.debug("push_task %s %s", spec.name, spec.task_id.hex()[:8])
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._exec_pool, self._execute, spec)
+
+    def _execute(self, spec: ts.TaskSpec) -> dict:
+        try:
+            fn = self.io.run(self.load_function(spec.fn_id))
+            args, kwargs = ts.decode_args(
+                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+            )
+            attempts = 0
+            while True:
+                try:
+                    result = fn(*args, **kwargs)
+                    break
+                except Exception as e:  # noqa: BLE001 - user exception
+                    attempts += 1
+                    if spec.retry_exceptions and attempts <= spec.max_retries:
+                        continue
+                    return self._error_result(spec, e)
+            return self._success_result(spec, result)
+        except exc.RayTpuError as e:
+            return self._error_result(spec, e, system=True)
+        except BaseException as e:  # noqa: BLE001
+            return self._error_result(spec, e)
+
+    def _success_result(self, spec: ts.TaskSpec, result) -> dict:
+        n = spec.num_returns
+        values = [result] if n == 1 else list(result)
+        if n != 1 and len(values) != n:
+            return self._error_result(
+                spec,
+                ValueError(
+                    f"task declared num_returns={n} but returned {len(values)}"
+                ),
+            )
+        entries = []
+        for i, v in enumerate(values):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            data = serialization.serialize(v).to_bytes()
+            if len(data) <= _config.max_direct_call_object_size:
+                entries.append(("inline", data))
+            else:
+                self.shm.put_bytes(oid, data)
+                if self.raylet:
+                    self.io.spawn(self._notify_object_added(oid, len(data)))
+                entries.append(
+                    (
+                        "location",
+                        {
+                            "session": self.session,
+                            "raylet_addr": self.raylet_address,
+                            "node_id": self.node_id,
+                            "nbytes": len(data),
+                        },
+                    )
+                )
+        return {"results": entries}
+
+    def _error_result(self, spec: ts.TaskSpec, e: BaseException, system=False) -> dict:
+        err = e if isinstance(e, exc.RayTpuError) else exc.TaskError.from_exception(e)
+        blob = cloudpickle.dumps(err)
+        return {"results": [("error", blob)] * max(1, spec.num_returns)}
+
+    # -------------------------------------------------------------- actors
+    def _init_actor(self, spec_blob):
+        try:
+            spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
+            cls = self.io.run(self.load_function(spec.fn_id))
+            args, kwargs = ts.decode_args(
+                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+            )
+            opts = spec.actor_options or {}
+            n = max(1, opts.get("max_concurrency", 1))
+            if n > 1:
+                self._exec_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="actor-exec"
+                )
+            self.actor_instance = cls(*args, **kwargs)
+            self._actor_ready.set()
+            self.io.run(
+                self.gcs.call(
+                    "actor_ready",
+                    actor_id=self.actor_id,
+                    address=self.address,
+                    node_id=self.node_id,
+                )
+            )
+        except BaseException as e:  # noqa: BLE001
+            logger.error("actor init failed: %s", traceback.format_exc())
+            self._actor_init_error = e
+            self._actor_ready.set()
+            try:
+                self.io.run(
+                    self.gcs.call(
+                        "actor_failed",
+                        actor_id=self.actor_id,
+                        reason=f"__init__ raised {e!r}",
+                    )
+                )
+            finally:
+                os._exit(1)
+
+    async def handle_push_actor_task(self, conn, spec_blob):
+        """Execute an actor call. Ordering: each owner sends one call at a
+        time (owner-side FIFO queue), and the executor pool serializes
+        execution, so arrival order == submission order per owner."""
+        spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec_pool, self._execute_actor_task, spec
+        )
+
+    def _execute_actor_task(self, spec: ts.TaskSpec) -> dict:
+        self._actor_ready.wait(timeout=_config.worker_startup_timeout_s)
+        if self._actor_init_error is not None:
+            return self._error_result(spec, self._actor_init_error)
+        try:
+            method = getattr(self.actor_instance, spec.actor_method)
+            args, kwargs = ts.decode_args(
+                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+            )
+            result = method(*args, **kwargs)
+            import inspect
+
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return self._success_result(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            return self._error_result(spec, e)
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {os.getpid()}] %(levelname)s %(message)s",
+    )
+    gcs = os.environ["RAY_TPU_GCS_ADDRESS"]
+    raylet = os.environ["RAY_TPU_RAYLET_ADDRESS"]
+    session = os.environ["RAY_TPU_SESSION"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    token = int(os.environ["RAY_TPU_STARTUP_TOKEN"])
+
+    agent = WorkerAgent(gcs, raylet, session, node_id)
+    agent.connect()
+    agent.register_with_raylet(token)
+
+    # make nested @remote calls work inside tasks
+    from ray_tpu import api
+    from ray_tpu.core.cluster_backend import ClusterBackend
+
+    api._worker.backend = ClusterBackend(core_worker=agent)
+    api._worker.mode = "worker"
+
+    # serve until killed (all work arrives over RPC)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

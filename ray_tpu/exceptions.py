@@ -43,6 +43,9 @@ class TaskError(RayTpuError):
     def as_instanceof_cause(self):
         return self.cause if self.cause is not None else self
 
+    def __reduce__(self):
+        return (TaskError, (self.cause_cls_name, self.traceback_str, self.cause))
+
 
 class TaskCancelledError(RayTpuError):
     pass

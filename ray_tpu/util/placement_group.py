@@ -1,0 +1,78 @@
+"""Placement groups: gang-reserve resource bundles across nodes.
+
+Parity: python/ray/util/placement_group.py:34,139. TPU-first extra: PACK
+strategies prefer nodes sharing an ICI slice (see scheduling_policy.pack_bundles).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core.ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        from ray_tpu.api import _global_worker
+
+        backend = _global_worker().backend
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = backend.get_placement_group(self.id.binary())
+            if info and info["state"] == "CREATED":
+                return True
+            time.sleep(0.1)
+        return False
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id.hex()[:12]}, {self.strategy}, {self.bundles})"
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"invalid strategy {strategy}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be non-empty resource dicts")
+    from ray_tpu.api import _auto_init, _global_worker
+
+    _auto_init()
+    backend = _global_worker().backend
+    pg_id = PlacementGroupID.from_random()
+    backend.create_placement_group(
+        pg_id.binary(), bundles, strategy
+    )
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.api import _global_worker
+
+    _global_worker().backend.remove_placement_group(pg.id.binary())
+
+
+class PlacementGroupSchedulingStrategy:
+    """scheduling_strategy= value targeting a PG bundle (reference:
+    util/scheduling_strategies.py:41)."""
+
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks

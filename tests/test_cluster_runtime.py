@@ -1,0 +1,168 @@
+"""Real multi-process cluster tests (GCS + raylet + workers + shm store).
+
+Parity: python/ray/tests/ run against a real single-node cluster
+(ray_start_regular, conftest.py:351) — never a simulated runtime.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_task_and_fanout(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def mul(a, b):
+        return a * b
+
+    assert ray.get(mul.remote(6, 7), timeout=60) == 42
+    assert ray.get([mul.remote(i, 2) for i in range(8)], timeout=60) == [
+        0, 2, 4, 6, 8, 10, 12, 14,
+    ]
+
+
+def test_large_objects_roundtrip_shm(ray_cluster):
+    ray = ray_cluster
+    arr = np.arange(500_000, dtype=np.float64)
+    ref = ray.put(arr)
+    out = ray.get(ref, timeout=60)
+    np.testing.assert_array_equal(out, arr)
+
+    @ray.remote
+    def make():
+        return np.ones((512, 512), dtype=np.float32)
+
+    out = ray.get(make.remote(), timeout=60)
+    assert out.shape == (512, 512) and out.dtype == np.float32
+
+    @ray.remote
+    def consume(x):
+        return float(x.sum())
+
+    assert ray.get(consume.remote(ref), timeout=60) == float(arr.sum())
+
+
+def test_task_error_propagation(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def boom():
+        raise ValueError("cluster kapow")
+
+    with pytest.raises(ValueError, match="cluster kapow"):
+        ray.get(boom.remote(), timeout=60)
+
+
+def test_nested_tasks(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def leaf(x):
+        return x + 1
+
+    @ray.remote
+    def parent():
+        return sum(ray.get([leaf.remote(i) for i in range(3)]))
+
+    assert ray.get(parent.remote(), timeout=90) == 6
+
+
+def test_actor_lifecycle_and_state(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Acc:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    a = Acc.remote(100)
+    assert ray.get([a.add.remote(1) for _ in range(5)], timeout=60) == [
+        101, 102, 103, 104, 105,
+    ]
+
+
+def test_actor_error_and_kill(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor cluster oops")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor cluster oops"):
+        ray.get(b.fail.remote(), timeout=60)
+    assert ray.get(b.ok.remote(), timeout=60) == 1
+    ray.kill(b)
+    with pytest.raises(ray.exceptions.ActorDiedError):
+        ray.get(b.ok.remote(), timeout=60)
+
+
+def test_named_actor_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Registry:
+        def get(self):
+            return "reg"
+
+    keep = Registry.options(name="cluster-reg").remote()
+    h = ray.get_actor("cluster-reg")
+    assert ray.get(h.get.remote(), timeout=60) == "reg"
+
+
+def test_wait_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def fast():
+        return 1
+
+    @ray.remote
+    def slow():
+        time.sleep(20)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray.wait([f, s], num_returns=1, timeout=15)
+    assert ready == [f] and not_ready == [s]
+
+
+def test_worker_crash_retries_then_errors(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(17)
+
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(die.remote(), timeout=90)
+
+
+def test_cluster_resources_reported(ray_cluster):
+    ray = ray_cluster
+    res = ray.cluster_resources()
+    assert res.get("CPU") == 2.0
+    nodes = ray.nodes()
+    assert len(nodes) == 1 and nodes[0]["Alive"]
