@@ -367,7 +367,7 @@ class CoreWorker:
 
     # ------------------------------------------------------- task submission
     def register_function(self, fn) -> bytes:
-        blob = cloudpickle.dumps(fn)
+        blob = _pickle_callable(fn)
         fn_id = ts.function_id(blob)
         if fn_id not in self._registered_fns:
             self.io.run(self.gcs.call("register_function", fn_id=fn_id, blob=blob))
@@ -533,7 +533,7 @@ class CoreWorker:
     # ---------------------------------------------------------- actor calls
     def create_actor(self, cls, args, kwargs, options: RemoteOptions) -> ActorID:
         actor_id = ActorID.from_random()
-        blob = cloudpickle.dumps(cls)
+        blob = _pickle_callable(cls)
         fn_id = ts.function_id(blob)
         if fn_id not in self._registered_fns:
             self.io.run(self.gcs.call("register_function", fn_id=fn_id, blob=blob))
@@ -686,6 +686,38 @@ class CoreWorker:
         if info is None:
             raise ValueError(f"Failed to look up actor '{name}'")
         return ActorID(info["actor_id"])
+
+
+def _pickle_callable(fn) -> bytes:
+    """cloudpickle, forcing by-VALUE serialization for callables defined in
+    modules workers cannot import (user scripts, test files) — installed
+    packages still pickle by reference (reference behavior: function export
+    via the GCS function table, function_manager.py)."""
+    import sys
+    import sysconfig
+
+    mod_name = getattr(fn, "__module__", "") or ""
+    mod = sys.modules.get(mod_name)
+    if mod is None or mod_name in ("__main__", "builtins"):
+        return cloudpickle.dumps(fn)
+    f = getattr(mod, "__file__", "") or ""
+    stdlib = sysconfig.get_paths().get("stdlib", "//")
+    if (
+        not f
+        or "site-packages" in f
+        or "dist-packages" in f
+        or f.startswith(stdlib)
+        or "/ray_tpu/" in f.replace("\\", "/")
+    ):
+        return cloudpickle.dumps(fn)
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+        try:
+            return cloudpickle.dumps(fn)
+        finally:
+            cloudpickle.unregister_pickle_by_value(mod)
+    except Exception:  # noqa: BLE001 - fall back to by-reference
+        return cloudpickle.dumps(fn)
 
 
 def _pg_fields(options: RemoteOptions):

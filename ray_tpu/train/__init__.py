@@ -1,0 +1,39 @@
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_local_rank,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "report",
+    "get_checkpoint",
+    "get_context",
+    "get_world_rank",
+    "get_world_size",
+    "get_local_rank",
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "Result",
+]

@@ -1,0 +1,89 @@
+"""Checkpoint: dict ↔ directory ↔ (cloud URI later), orbax for jax pytrees.
+
+Parity: python/ray/air/checkpoint.py:66 — a Checkpoint is a handle convertible
+between representations; Train workers ship them to the driver via
+session.report. TPU-native: `from_jax`/`to_jax` store sharded pytrees through
+orbax (which understands jax.Array sharding and restores onto a target mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None):
+        if (data is None) == (path is None):
+            raise ValueError("exactly one of data= or path= required")
+        self._data = data
+        self._path = path
+
+    # ------------------------------------------------------------- factories
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=data)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=path)
+
+    @classmethod
+    def from_jax(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Save a jax pytree (possibly sharded across a mesh) with orbax."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        ckpt_dir = os.path.join(os.path.abspath(path), "jax_state")
+        ckptr = ocp.StandardCheckpointer()
+        host_tree = jax.device_get(tree)
+        ckptr.save(ckpt_dir, host_tree, force=True)
+        ckptr.wait_until_finished()
+        return cls(path=path)
+
+    # ----------------------------------------------------------- conversions
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        blob = os.path.join(self._path, "_dict_payload.pkl")
+        if os.path.exists(blob):
+            with open(blob, "rb") as f:
+                return pickle.load(f)
+        raise ValueError("directory checkpoint has no dict payload")
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if self._path is not None:
+            if path and os.path.abspath(path) != os.path.abspath(self._path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+                return path
+            return self._path
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "_dict_payload.pkl"), "wb") as f:
+            pickle.dump(self._data, f, protocol=5)
+        return path
+
+    def to_jax(self, target: Any = None) -> Any:
+        """Restore a jax pytree. `target` (an abstract/sharded example tree)
+        controls restored shardings — pass the freshly-initialized sharded
+        state to restore directly onto the mesh."""
+        import orbax.checkpoint as ocp
+
+        if self._path is None:
+            raise ValueError("to_jax requires a directory checkpoint")
+        ckpt_dir = os.path.join(os.path.abspath(self._path), "jax_state")
+        ckptr = ocp.StandardCheckpointer()
+        if target is not None:
+            return ckptr.restore(ckpt_dir, target)
+        return ckptr.restore(ckpt_dir)
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._path}"
+        return f"Checkpoint({kind})"

@@ -1,0 +1,53 @@
+"""Train/AIR config dataclasses.
+
+Parity: python/ray/air/config.py — ScalingConfig (:91), FailureConfig (:523),
+CheckpointConfig (:574), RunConfig (:704). TPU-first deltas: ScalingConfig
+speaks mesh axes (workers = hosts; each worker drives its host's chips via a
+global jax mesh), and `use_tpu` replaces `use_gpu`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1              # one per TPU host (standard jax multihost)
+    use_tpu: bool = False
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    tpus_per_worker: int = 0          # chips each host contributes
+    mesh: Optional[MeshSpec] = None   # global mesh over all workers' devices
+    placement_strategy: str = "PACK"  # keep hosts on one ICI slice
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", float(self.tpus_per_worker or 1))
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # trial restarts from latest checkpoint
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1

@@ -1,0 +1,173 @@
+"""Trainers: JaxTrainer / DataParallelTrainer → Result.
+
+Parity: train/base_trainer.py:68 (BaseTrainer, fit :559),
+data_parallel_trainer.py:58, torch/torch_trainer.py:15 (here: JaxTrainer).
+The reference runs fit() as a 1-trial Tune experiment; ours drives the worker
+group directly and the Tune layer wraps trainers the same way from above
+(tune.Tuner(trainer) — see ray_tpu.tune).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[BaseException]
+    metrics_dataframe: Optional[List[Dict[str, Any]]] = None
+    path: Optional[str] = None
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Adapter so Tune can run this trainer as a trial (reference:
+        BaseTrainer.as_trainable — Train is a 1-trial Tune run)."""
+        trainer = self
+
+        def trainable(config, _session=None):
+            import copy
+
+            t = copy.copy(trainer)
+            merged = dict(getattr(t, "train_loop_config", None) or {})
+            merged.update(config or {})
+            t.train_loop_config = merged
+            result = t.fit()
+            if result.error:
+                raise result.error
+            return result.metrics
+
+        trainable.__name__ = type(self).__name__
+        return trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """SPMD training: the same train_loop_per_worker runs on every worker
+    (one per host), with jax.distributed connecting hosts into one device
+    mesh."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self.scaling_config
+        run_cfg = self.run_config
+        name = run_cfg.name or f"train-{uuid.uuid4().hex[:6]}"
+        failures_left = run_cfg.failure_config.max_failures
+        latest_ckpt = self.resume_from_checkpoint
+        history: List[Dict[str, Any]] = []
+
+        while True:
+            group = WorkerGroup(
+                cfg.num_workers,
+                cfg.worker_resources(),
+                experiment_name=name,
+                placement_strategy=cfg.placement_strategy,
+            )
+            try:
+                group.rendezvous()
+                group.for_all(
+                    "start_training",
+                    self.train_loop_per_worker,
+                    self.train_loop_config,
+                    latest_ckpt,
+                )
+                error = self._drive(group, history)
+                if error is None:
+                    metrics = history[-1] if history else None
+                    ckpt = self._latest_group_checkpoint(group) or latest_ckpt
+                    return Result(
+                        metrics=metrics,
+                        checkpoint=ckpt,
+                        error=None,
+                        metrics_dataframe=history,
+                    )
+                latest_ckpt = self._latest_group_checkpoint(group) or latest_ckpt
+                if failures_left == 0:
+                    return Result(
+                        metrics=history[-1] if history else None,
+                        checkpoint=latest_ckpt,
+                        error=error,
+                        metrics_dataframe=history,
+                    )
+                failures_left -= 1
+            finally:
+                group.shutdown()
+
+    def _drive(self, group: WorkerGroup, history) -> Optional[BaseException]:
+        """Poll rank 0 for reports until all workers finish (reference: the
+        driver consumes the session queue, train/_internal/session.py:421)."""
+        import ray_tpu
+
+        done = [False] * group.num_workers
+        self._last_checkpoint = None
+        while not all(done):
+            events = ray_tpu.get(
+                [w.poll.remote(1.0) for w in group.workers], timeout=600
+            )
+            for rank, evs in enumerate(events):
+                for kind, metrics, ckpt in evs:
+                    if kind == "done":
+                        done[rank] = True
+                    elif kind == "report" and rank == 0:
+                        history.append(metrics)
+                        if ckpt is not None:
+                            self._last_checkpoint = ckpt
+            time.sleep(0.05)
+        for w in group.workers:
+            try:
+                ray_tpu.get(w.get_error.remote(), timeout=60)
+            except BaseException as e:  # noqa: BLE001
+                return e
+        return None
+
+    def _latest_group_checkpoint(self, group):
+        return getattr(self, "_last_checkpoint", None)
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship trainer (reference analog: TorchTrainer). Workers get a
+    jax.distributed-initialized runtime; the user train loop builds a mesh
+    over jax.devices() and pjit-shards its model (see models/gpt2 +
+    train/train_step for the canonical step)."""

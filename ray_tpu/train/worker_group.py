@@ -1,0 +1,185 @@
+"""Train worker group: N actors, one per host, running user train loops.
+
+Parity: train/_internal/worker_group.py:100 (WorkerGroup of plain actors) +
+backend_executor.py:45 (BackendExecutor: start → rendezvous → start_training).
+The rendezvous step is the TPU swap: instead of a torch NCCL/GLOO process
+group (torch/config.py:69), workers call jax.distributed.initialize against
+worker 0's coordinator port, after which jax.devices() spans all hosts and a
+global mesh covers the slice.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, _Session, _set_session
+
+
+class TrainWorker:
+    """Actor hosting one rank's train loop (run on its own thread so poll()
+    stays responsive on the actor's ordered queue)."""
+
+    def __init__(self, rank: int, world_size: int, experiment_name: str = ""):
+        self.rank = rank
+        self.world_size = world_size
+        self.experiment_name = experiment_name
+        self.session: Optional[_Session] = None
+        self._thread: Optional[threading.Thread] = None
+        self._distributed_ready = False
+
+    # ---------------------------------------------------------- rendezvous
+    def host_info(self) -> Dict[str, Any]:
+        ip = "127.0.0.1"
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+            s.close()
+        except OSError:
+            pass
+        free = socket.socket()
+        free.bind(("", 0))
+        port = free.getsockname()[1]
+        free.close()
+        return {"ip": ip, "port": port, "pid": os.getpid()}
+
+    def setup_jax_distributed(self, coordinator: str, num_processes: int,
+                              process_id: int) -> bool:
+        """jax.distributed over ICI/DCN — the NCCL-rendezvous replacement."""
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        self._distributed_ready = True
+        return True
+
+    # ------------------------------------------------------------ training
+    def start_training(self, fn: Callable, config: Dict[str, Any],
+                       latest_checkpoint: Optional[Checkpoint] = None) -> bool:
+        ctx = TrainContext(
+            world_rank=self.rank,
+            world_size=self.world_size,
+            local_rank=0,
+            experiment_name=self.experiment_name,
+        )
+        self.session = _Session(ctx, latest_checkpoint)
+
+        def run():
+            _set_session(self.session)
+            try:
+                fn(config) if config is not None else fn()
+                self.session.finish()
+            except BaseException as e:  # noqa: BLE001
+                traceback.print_exc()
+                self.session.finish(error=e)
+            finally:
+                _set_session(None)
+
+        self._thread = threading.Thread(target=run, daemon=True, name="train-fn")
+        self._thread.start()
+        return True
+
+    def poll(self, timeout: float = 1.0) -> List[tuple]:
+        """Drain pending (kind, metrics, checkpoint) events."""
+        out = []
+        if self.session is None:
+            return out
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                remaining = max(0.0, deadline - time.monotonic())
+                item = self.session.result_queue.get(timeout=remaining)
+                out.append(item)
+                if item[0] == "done":
+                    break
+            except Exception:  # noqa: BLE001 - queue.Empty
+                break
+        return out
+
+    def get_error(self):
+        if self.session and self.session.error is not None:
+            raise self.session.error
+        return None
+
+    def shutdown_worker(self) -> bool:
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 experiment_name: str = "", placement_strategy: str = "PACK"):
+        self.num_workers = num_workers
+        self.placement_group = None
+        actor_cls = ray_tpu.remote(TrainWorker)
+        opts: Dict[str, Any] = {
+            "num_cpus": resources_per_worker.get("CPU", 1),
+            "resources": {
+                k: v for k, v in resources_per_worker.items() if k not in ("CPU", "TPU")
+            },
+        }
+        if resources_per_worker.get("TPU"):
+            opts["num_tpus"] = resources_per_worker["TPU"]
+        if num_workers > 1:
+            from ray_tpu.util.placement_group import (
+                PlacementGroupSchedulingStrategy,
+                placement_group,
+            )
+
+            bundle = dict(resources_per_worker)
+            bundle.setdefault("CPU", 1)
+            self.placement_group = placement_group(
+                [bundle] * num_workers, strategy=placement_strategy
+            )
+            self.placement_group.ready(timeout=60)
+        self.workers = []
+        for rank in range(num_workers):
+            o = dict(opts)
+            if self.placement_group is not None:
+                o["placement_group"] = self.placement_group
+                o["placement_group_bundle_index"] = rank
+            self.workers.append(
+                actor_cls.options(**o).remote(rank, num_workers, experiment_name)
+            )
+
+    def for_all(self, method: str, *args, timeout: Optional[float] = 120, **kwargs):
+        refs = [
+            getattr(w, method).remote(*args, **kwargs) for w in self.workers
+        ]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def rendezvous(self):
+        """jax.distributed bootstrap across the group (no-op for 1 worker)."""
+        if self.num_workers <= 1:
+            return
+        infos = self.for_all("host_info")
+        coordinator = f"{infos[0]['ip']}:{infos[0]['port']}"
+        refs = [
+            w.setup_jax_distributed.remote(coordinator, self.num_workers, rank)
+            for rank, w in enumerate(self.workers)
+        ]
+        ray_tpu.get(refs, timeout=300)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.placement_group is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self.placement_group)
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,7 +1,7 @@
 """Multi-raylet (multi-"node") scheduling, object transfer, and chaos tests.
 
 Parity: python/ray/cluster_utils.py Cluster fixture + test_chaos.py patterns
-(SIGKILL a raylet under load, assert recovery/错误 surfaces cleanly).
+(SIGKILL a raylet under load, assert recovery/errors surface cleanly).
 """
 
 import time
