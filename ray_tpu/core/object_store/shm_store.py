@@ -141,7 +141,12 @@ class ObjectDirectory:
         self.capacity = capacity_bytes
         self.used = 0
         self.entries: Dict[ObjectID, _Entry] = {}
-        self.spill_dir = spill_dir
+        # Spilling is the eviction safety net (eviction never destroys the
+        # only copy), so a spill dir always exists — default under /tmp next
+        # to the session's logs.
+        self.spill_dir = spill_dir or os.path.join(
+            "/tmp", "ray_tpu_spill", os.path.basename(client.dir)
+        )
         self.spilled: Dict[ObjectID, str] = {}
         self._lock = threading.Lock()
 
@@ -193,7 +198,14 @@ class ObjectDirectory:
                     pass
 
     def _evict_locked(self, need: int) -> bool:
-        """LRU-evict unpinned objects (spilling them first when configured)."""
+        """LRU-evict unpinned objects, spilling them to disk first.
+
+        An object is only unlinked from shm once its bytes are safely on disk
+        (or already were): live ObjectRefs can always restore() it. Objects
+        that fail to spill are skipped — running out of evictable objects
+        makes this return False and the caller surfaces backpressure
+        (ObjectStoreFullError) instead of silently destroying live data.
+        """
         victims = sorted(
             (o for o, e in self.entries.items() if e.pins == 0),
             key=lambda o: self.entries[o].last_access,
@@ -202,9 +214,11 @@ class ObjectDirectory:
         for oid in victims:
             if freed >= need:
                 break
-            e = self.entries.pop(oid)
-            if self.spill_dir and oid not in self.spilled:
+            if oid not in self.spilled:
                 self._spill(oid)
+                if oid not in self.spilled:
+                    continue  # couldn't persist: not safe to evict
+            e = self.entries.pop(oid)
             self.client.delete(oid)
             self.used -= e.nbytes
             freed += e.nbytes

@@ -366,6 +366,14 @@ class Raylet:
         handle = self.pool.get_actor_worker(actor_id)
         if handle:
             self.pool.kill_worker(handle)
+            # kill_worker marks the handle DEAD, so poll_deaths never routes
+            # this through _on_worker_death — release the actor's resources
+            # here or the node permanently leaks them.
+            demand = self._actor_resources.pop(actor_id, None)
+            if demand is not None:
+                self.available = self.available.add(demand)
+            if handle.lease_id:
+                self.handle_return_lease(None, handle.lease_id)
             return True
         return False
 

@@ -16,11 +16,43 @@ from __future__ import annotations
 
 import io
 import pickle
+import types
 from typing import Any, List, Tuple
 
 import cloudpickle
 
 from ray_tpu.core.refs import ObjectRef
+
+
+def user_module_for_by_value(obj):
+    """If ``obj`` is a function/class from a module workers likely can't import
+    (user scripts, test files), return that module so it can be registered for
+    by-value pickling; installed packages, stdlib and ray_tpu itself pickle by
+    reference. Mirrors the reference's function-export semantics
+    (python/ray/_private/function_manager.py) for task/actor *arguments* too.
+    """
+    import sys
+    import sysconfig
+
+    if not isinstance(obj, (types.FunctionType, type)):
+        return None
+    mod_name = getattr(obj, "__module__", "") or ""
+    if mod_name in ("", "__main__", "builtins"):
+        return None
+    mod = sys.modules.get(mod_name)
+    if mod is None:
+        return None
+    f = getattr(mod, "__file__", "") or ""
+    stdlib = sysconfig.get_paths().get("stdlib", "//")
+    if (
+        not f
+        or "site-packages" in f
+        or "dist-packages" in f
+        or f.startswith(stdlib)
+        or "/ray_tpu/" in f.replace("\\", "/")
+    ):
+        return None
+    return mod
 
 # Buffers smaller than this stay in-band (copying beats bookkeeping).
 _OOB_THRESHOLD = 1 << 16  # 64 KiB
@@ -89,6 +121,8 @@ def _device_get_if_jax(value):
 def serialize(value: Any) -> SerializedObject:
     buffers: List[memoryview] = []
     contained_refs: List[ObjectRef] = []
+    registered_mods: List[Any] = []
+    registered_names = set()
 
     value = _device_get_if_jax(value)
 
@@ -116,11 +150,34 @@ def serialize(value: Any) -> SerializedObject:
                     return (_restore_ndarray, (pickle.PickleBuffer(arr), arr.dtype.str, arr.shape))
             except ImportError:  # pragma: no cover
                 pass
-            return NotImplemented
+            # Functions/classes from user modules (test files, scripts) must
+            # travel by VALUE — the worker can't import their module. Register
+            # the module before delegating so cloudpickle's own reduce path
+            # sees it in the by-value registry.
+            mod = user_module_for_by_value(obj)
+            if mod is not None and mod.__name__ not in registered_names:
+                try:
+                    cloudpickle.register_pickle_by_value(mod)
+                    registered_mods.append(mod)
+                    registered_names.add(mod.__name__)
+                except Exception:  # noqa: BLE001 - fall back to by-reference
+                    pass
+            # Delegate to cloudpickle so locally-defined / unimportable functions
+            # and classes are still pickled by value (the whole point of using
+            # CloudPickler); returning NotImplemented here would silently fall
+            # back to stdlib pickle for them.
+            return super().reducer_override(obj)
 
     out = io.BytesIO()
     p = _Pickler(out, protocol=5, buffer_callback=buffer_callback)
-    p.dump(value)
+    try:
+        p.dump(value)
+    finally:
+        for mod in registered_mods:
+            try:
+                cloudpickle.unregister_pickle_by_value(mod)
+            except Exception:  # noqa: BLE001
+                pass
     return SerializedObject(out.getvalue(), buffers, contained_refs)
 
 

@@ -177,9 +177,9 @@ def _attention(q, k, v, cfg: GPT2Config):
     """q,k,v: [B, S, H, hd] → [B, S, H, hd], causal."""
     impl = cfg.attention_impl
     if impl == "auto":
-        # pallas flash kernel becomes the TPU default once ops/attention.py
-        # benchmarks ahead of the XLA fusion; until then XLA everywhere.
-        impl = "xla"
+        # TPU: the Pallas flash kernel (no S×S residuals → no full remat).
+        # Elsewhere: XLA einsum path (flash-in-interpret-mode is slow).
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
         try:
             from ray_tpu.ops.attention import flash_attention

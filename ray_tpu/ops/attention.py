@@ -1,0 +1,474 @@
+"""Flash attention for TPU as Pallas kernels (fwd + bwd, causal, custom VJP).
+
+This is the perf-critical op the XLA fallback can't match: XLA materializes the
+[S, S] probability matrix as a backward residual per layer, forcing full remat
+at GPT-2 batch sizes (see bench.py). The kernels below keep the online-softmax
+running state (m, l, acc) in VMEM and never write probabilities to HBM; the
+backward pass recomputes logits blockwise from (q, k, lse) the flash-attention
+way.
+
+Design notes (TPU-first):
+- Kernels operate in [B, H, S, hd] layout so every block's minor dims are the
+  (seq, head_dim) tile Mosaic requires ((8,128)-aligned or full-size); the
+  public API takes [B, S, H, hd] and transposes at the boundary (XLA fuses the
+  transpose into the surrounding projection matmuls).
+- K/V live whole per (batch, head) in VMEM (S·hd·2B ≈ 128 KiB at S=1024 —
+  VMEM is ~16 MiB), so the kv loop is VMEM-resident with no DMA choreography.
+- Logits/softmax accumulate in f32 (MXU native via preferred_element_type);
+  p·v and the backward matmuls run bf16→f32.
+- The causal mask is computed from GLOBAL positions `q_offset`/`kv_offset`
+  (scalar-prefetch args), so the same kernel serves single-device attention
+  (offsets 0) and ring attention (per-step rotated offsets, ops/ring_attention).
+- Backward = two kernels: dq (grid over q blocks, loop kv) and dk/dv (grid
+  over kv blocks, loop q) — no atomics, each output block written exactly once.
+- lse/delta ride as [B, H, 1, S] so their (1, block) tiles satisfy the minor-
+  dim rules; squeezed to [B, H, S] at the API edge.
+
+No counterpart exists in the reference (it has no flash/SP story at all —
+SURVEY.md §2.10); this is new TPU-native code.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # mask value: large-negative, not -inf (keeps exp() exact 0)
+
+
+def _pick_block(seq_len: int, preferred: int) -> int:
+    b = min(preferred, seq_len)
+    while seq_len % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------------- #
+
+def _fwd_kernel(
+    q_off_ref, kv_off_ref,            # scalar prefetch: global offsets [1]
+    q_ref, k_ref, v_ref,              # [1, 1, bq, hd], [1, 1, Skv, hd] ×2
+    o_ref, lse_ref,                   # [1, 1, bq, hd], [1, 1, 1, bq]
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :]
+    hd = q.shape[-1]
+    q_global = q_off_ref[0] + qi * block_q
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, hd), jnp.float32)
+
+    nk = kv_len // block_k
+    if causal:
+        # only kv blocks whose global start can be <= the last query row
+        last_q = q_global + block_q - 1
+        num_blocks = jnp.clip(
+            (last_q - kv_off_ref[0]) // block_k + 1, 0, nk
+        )
+    else:
+        num_blocks = nk
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_global + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        acc = acc * alpha + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    # rows with no valid kv (ring attention future chunks): l == 0 → output 0,
+    # lse = -inf-ish so the ring merge gives them zero weight.
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0, :, :] = (acc / l_safe).astype(o_ref.dtype)
+    lse = jnp.where(
+        l[:, 0] > 0, m[:, 0] + jnp.log(l_safe[:, 0]), _NEG_INF
+    )
+    lse_ref[0, 0, 0, :] = lse
+
+
+def _mha_forward_bhsd(
+    q, k, v, q_offset, kv_offset, *,
+    causal: bool, scale: float, block_q: int, block_k: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """q,k,v: [B, H, S, hd] → (o [B,H,S,hd], lse [B,H,S])."""
+    B, H, Sq, hd = q.shape
+    Skv = k.shape[2]
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Skv, block_k)
+    grid = (B, H, Sq // bq)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, kv_len=Skv,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((B, H, 1, Sq), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, *_: (b, h, 0, i)),
+            ],
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q_offset, kv_offset, q, k, v)
+    return o, lse[:, :, 0, :]
+
+
+# --------------------------------------------------------------------------- #
+# Backward
+# --------------------------------------------------------------------------- #
+
+def _dq_kernel(
+    q_off_ref, kv_off_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    lse = lse_ref[0, 0, 0, :][:, None]       # [bq, 1]
+    delta = delta_ref[0, 0, 0, :][:, None]   # [bq, 1]
+    hd = q.shape[-1]
+    q_global = q_off_ref[0] + qi * block_q
+
+    nk = kv_len // block_k
+    if causal:
+        last_q = q_global + block_q - 1
+        num_blocks = jnp.clip((last_q - kv_off_ref[0]) // block_k + 1, 0, nk)
+    else:
+        num_blocks = nk
+
+    def body(ki, dq):
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_global + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                     # [bq, bk] f32
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq = dq + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dq
+
+    dq = lax.fori_loop(
+        0, num_blocks, body, jnp.zeros((block_q, hd), jnp.float32)
+    )
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_off_ref, kv_off_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, q_len: int,
+):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    hd = k.shape[-1]
+    block_k_ = k.shape[0]
+    kv_global = kv_off_ref[0] + ki * block_k_
+
+    nq = q_len // block_q
+    if causal:
+        # first q block whose global end reaches this kv block's start
+        first = jnp.clip((kv_global - q_off_ref[0]) // block_q, 0, nq)
+    else:
+        first = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_off_ref[0] + qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = kv_global + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                     # [bq, bk]
+        dv = dv + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk = dk + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k_, hd), jnp.float32)
+    dv0 = jnp.zeros((block_k_, hd), jnp.float32)
+    dk, dv = lax.fori_loop(first, nq, body, (dk0, dv0))
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _mha_backward_bhsd(
+    q, k, v, o, lse, do, q_offset, kv_offset, *,
+    causal: bool, scale: float, block_q: int, block_k: int, interpret: bool,
+):
+    """All tensors [B, H, S, hd]; lse [B, H, S]. Returns dq, dk, dv."""
+    B, H, Sq, hd = q.shape
+    Skv = k.shape[2]
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Skv, block_k)
+
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, :, None, :]                       # [B, H, 1, Sq]
+    lse4 = lse[:, :, None, :]              # [B, H, 1, Sq]
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, kv_len=Skv,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, *_: (b, h, 0, i)),
+                pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, *_: (b, h, 0, i)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q_offset, kv_offset, q, k, v, do, lse4, delta)
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, q_len=Sq,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, Skv // bk),
+            in_specs=[
+                pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, Sq), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, Sq), lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q_offset, kv_offset, q, k, v, do, lse4, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
+# Public API ([B, S, H, hd] boundary layout)
+# --------------------------------------------------------------------------- #
+
+def _to_bhsd(x):
+    return jnp.swapaxes(x, 1, 2)
+
+
+def _zero_off():
+    return jnp.zeros((1,), jnp.int32)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _mha_forward_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _zero_off(), _zero_off(),
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return _to_bhsd(o)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    o, lse = _mha_forward_bhsd(
+        qt, kt, vt, _zero_off(), _zero_off(),
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return _to_bhsd(o), (qt, kt, vt, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    qt, kt, vt, o, lse = res
+    dq, dk, dv = _mha_backward_bhsd(
+        qt, kt, vt, o, lse, _to_bhsd(do), _zero_off(), _zero_off(),
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return _to_bhsd(dq), _to_bhsd(dk), _to_bhsd(dv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-head flash attention. q,k,v: [B, S, H, hd] → [B, S, H, hd].
+
+    Differentiable (custom VJP, flash backward). On non-TPU backends the
+    kernels run in Pallas interpreter mode so tests validate the same code.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def flash_attention_with_lse(
+    q, k, v, q_offset, kv_offset, *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Forward-only flash attention returning (out [B,S,H,hd], lse [B,H,S])
+    with GLOBAL position offsets — the building block for ring attention's
+    per-step chunk computation (ops/ring_attention.py merges partials by lse).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    q_off = jnp.asarray([q_offset], jnp.int32).reshape(1)
+    kv_off = jnp.asarray([kv_offset], jnp.int32).reshape(1)
+    o, lse = _mha_forward_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), q_off, kv_off,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return _to_bhsd(o), lse
+
+
+def mha_backward_chunk(
+    q, k, v, o, lse, do, q_offset, kv_offset, *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """Backward for one (q-chunk, kv-chunk) pair with global offsets; returns
+    (dq, dk, dv) contributions (all [B,S,H,hd]). `lse` is the GLOBAL logsumexp
+    over all chunks. Used by ring attention's backward ring pass."""
+    if interpret is None:
+        interpret = _use_interpret()
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    q_off = jnp.asarray([q_offset], jnp.int32).reshape(1)
+    kv_off = jnp.asarray([kv_offset], jnp.int32).reshape(1)
+    dq, dk, dv = _mha_backward_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(o), lse,
+        _to_bhsd(do), q_off, kv_off,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return _to_bhsd(dq), _to_bhsd(dk), _to_bhsd(dv)

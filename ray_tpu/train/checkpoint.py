@@ -81,7 +81,13 @@ class Checkpoint:
         ckpt_dir = os.path.join(os.path.abspath(self._path), "jax_state")
         ckptr = ocp.StandardCheckpointer()
         if target is not None:
-            return ckptr.restore(ckpt_dir, target)
+            try:
+                return ckptr.restore(ckpt_dir, target)
+            except Exception:  # noqa: BLE001
+                # Target tree structure doesn't match what was saved (e.g. the
+                # checkpoint wraps params under extra keys). Restore the saved
+                # structure as-is; caller unpacks.
+                pass
         return ckptr.restore(ckpt_dir)
 
     def __repr__(self):

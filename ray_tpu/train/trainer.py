@@ -106,14 +106,21 @@ class DataParallelTrainer(BaseTrainer):
                 placement_strategy=cfg.placement_strategy,
             )
             try:
-                group.rendezvous()
-                group.for_all(
-                    "start_training",
-                    self.train_loop_per_worker,
-                    self.train_loop_config,
-                    latest_ckpt,
-                )
-                error = self._drive(group, history)
+                try:
+                    group.rendezvous()
+                    group.for_all(
+                        "start_training",
+                        self.train_loop_per_worker,
+                        self.train_loop_config,
+                        latest_ckpt,
+                    )
+                    error = self._drive(group, history)
+                except BaseException as e:  # noqa: BLE001
+                    # Worker-process death (ActorDiedError, rpc loss) must flow
+                    # into the same FailureConfig retry loop as user-code errors
+                    # — elastic restart-from-checkpoint is the whole point
+                    # (reference: Tune trial FailureConfig handling).
+                    error = e
                 if error is None:
                     metrics = history[-1] if history else None
                     ckpt = self._latest_group_checkpoint(group) or latest_ckpt

@@ -1,0 +1,120 @@
+"""Flash-attention kernel numerics vs the XLA reference path (CPU interpret).
+
+Reference for *behavior* is plain softmax attention; the reference repo has no
+flash/SP implementation at all (SURVEY.md §2.10), so these are fresh numerics.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_with_lse,
+)
+
+
+def ref_attention(q, k, v, causal=True):
+    S, Skv = q.shape[1], k.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, Skv), dtype=bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+
+
+def make_qkv(key, B=2, S=256, H=4, hd=64, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (B, S, H, hd), dtype)
+    k = jax.random.normal(k2, (B, S, H, hd), dtype)
+    v = jax.random.normal(k3, (B, S, H, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = make_qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_forward_nondivisible_block_fallback():
+    # S=160 not divisible by 64 → _pick_block halves until it divides
+    q, k, v = make_qkv(jax.random.PRNGKey(1), S=160)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    q, k, v = make_qkv(jax.random.PRNGKey(2), B=1, S=128, H=2, hd=32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(ref_attention(q, k, v, causal=causal)))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_lse_and_offsets():
+    """Global offsets: computing attention of a q chunk against a kv chunk at
+    a rotated position must equal the corresponding slice of full attention."""
+    B, S, H, hd = 1, 128, 2, 32
+    q, k, v = make_qkv(jax.random.PRNGKey(3), B=B, S=S, H=H, hd=hd)
+    half = S // 2
+
+    # full causal attention, second half of queries
+    ref = ref_attention(q, k, v, causal=True)[:, half:]
+
+    # ring-style: q2 against kv chunk 0 (fully visible) and kv chunk 1 (causal)
+    q2 = q[:, half:]
+    o_a, lse_a = flash_attention_with_lse(
+        q2, k[:, :half], v[:, :half], half, 0, block_q=32, block_k=32
+    )
+    o_b, lse_b = flash_attention_with_lse(
+        q2, k[:, half:], v[:, half:], half, half, block_q=32, block_k=32
+    )
+    # merge partials by lse
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)[..., None]   # [B,H,Sq,1]
+    wb = jnp.exp(lse_b - m)[..., None]
+    oa = jnp.moveaxis(o_a.astype(jnp.float32), 1, 2)  # [B,H,S,hd]
+    ob = jnp.moveaxis(o_b.astype(jnp.float32), 1, 2)
+    merged = (oa * wa + ob * wb) / (wa + wb)
+    merged = jnp.moveaxis(merged, 2, 1)
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_fully_masked_chunk_is_zero_weight():
+    """A kv chunk entirely in the future must come back with lse ≈ -inf and
+    contribute nothing after the merge."""
+    B, S, H, hd = 1, 64, 1, 32
+    q, k, v = make_qkv(jax.random.PRNGKey(4), B=B, S=S, H=H, hd=hd)
+    # kv offset far beyond all queries
+    o, lse = flash_attention_with_lse(
+        q, k, v, 0, 10_000, block_q=32, block_k=32
+    )
+    assert np.all(np.asarray(lse) < -1e29)
+    np.testing.assert_array_equal(np.asarray(o), 0.0)
