@@ -117,6 +117,13 @@ class ShmClient:
 
     def destroy(self):
         shutil.rmtree(self.dir, ignore_errors=True)
+        # Also reclaim this session's default spill directory (ObjectDirectory
+        # derives it from the shm dir name) — spilled objects must not outlive
+        # the session (advisor finding r2).
+        shutil.rmtree(
+            os.path.join("/tmp", "ray_tpu_spill", os.path.basename(self.dir)),
+            ignore_errors=True,
+        )
 
 
 @dataclass
@@ -247,6 +254,11 @@ class ObjectDirectory:
         self.client.put_bytes(oid, data)
         self.add(oid, len(data))
         return True
+
+    def destroy(self):
+        """Session teardown: remove the spill directory with the shm dir so
+        spilled objects don't accumulate across sessions (advisor finding r2)."""
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
 
     def stats(self) -> dict:
         return {

@@ -135,6 +135,9 @@ class Raylet:
         if self.gcs:
             await self.gcs.close()
         await self.server.close()
+        # reclaim this raylet's spill directory (covers configured spill dirs;
+        # ShmClient.destroy only knows the default location)
+        self.directory.destroy()
 
     async def _report_loop(self):
         period = _config.health_check_period_ms / 1000

@@ -16,8 +16,9 @@ from __future__ import annotations
 
 import io
 import pickle
+import threading
 import types
-from typing import Any, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 import cloudpickle
 
@@ -118,6 +119,38 @@ def _device_get_if_jax(value):
     return value
 
 
+# cloudpickle.register_pickle_by_value mutates process-global state; concurrent
+# serialize() calls must not unregister a module while another dump is mid-
+# flight (advisor finding r2). Registrations are reference-counted under a lock.
+_BY_VALUE_LOCK = threading.Lock()
+_BY_VALUE_COUNTS: Dict[str, int] = {}
+
+
+def _register_by_value(mod) -> bool:
+    with _BY_VALUE_LOCK:
+        n = _BY_VALUE_COUNTS.get(mod.__name__, 0)
+        if n == 0:
+            try:
+                cloudpickle.register_pickle_by_value(mod)
+            except Exception:  # noqa: BLE001 - fall back to by-reference
+                return False
+        _BY_VALUE_COUNTS[mod.__name__] = n + 1
+        return True
+
+
+def _unregister_by_value(mod) -> None:
+    with _BY_VALUE_LOCK:
+        n = _BY_VALUE_COUNTS.get(mod.__name__, 0)
+        if n <= 1:
+            _BY_VALUE_COUNTS.pop(mod.__name__, None)
+            try:
+                cloudpickle.unregister_pickle_by_value(mod)
+            except Exception:  # noqa: BLE001
+                pass
+        else:
+            _BY_VALUE_COUNTS[mod.__name__] = n - 1
+
+
 def serialize(value: Any) -> SerializedObject:
     buffers: List[memoryview] = []
     contained_refs: List[ObjectRef] = []
@@ -156,12 +189,9 @@ def serialize(value: Any) -> SerializedObject:
             # sees it in the by-value registry.
             mod = user_module_for_by_value(obj)
             if mod is not None and mod.__name__ not in registered_names:
-                try:
-                    cloudpickle.register_pickle_by_value(mod)
+                if _register_by_value(mod):
                     registered_mods.append(mod)
                     registered_names.add(mod.__name__)
-                except Exception:  # noqa: BLE001 - fall back to by-reference
-                    pass
             # Delegate to cloudpickle so locally-defined / unimportable functions
             # and classes are still pickled by value (the whole point of using
             # CloudPickler); returning NotImplemented here would silently fall
@@ -174,10 +204,7 @@ def serialize(value: Any) -> SerializedObject:
         p.dump(value)
     finally:
         for mod in registered_mods:
-            try:
-                cloudpickle.unregister_pickle_by_value(mod)
-            except Exception:  # noqa: BLE001
-                pass
+            _unregister_by_value(mod)
     return SerializedObject(out.getvalue(), buffers, contained_refs)
 
 

@@ -40,7 +40,13 @@ class GPT2Config:
     dropout: float = 0.0          # pretraining default; nonzero not yet implemented
     dtype: Any = jnp.bfloat16     # activation/compute dtype
     param_dtype: Any = jnp.float32
-    remat: bool = False           # jax.checkpoint each block (memory/flops trade)
+    # Rematerialization of each block (memory/FLOPs trade):
+    #   False  — save all residuals (fastest, most HBM)
+    #   True   — full block remat (one extra forward, least HBM)
+    #   "dots" — policy remat: keep matmul outputs, recompute elementwise ops
+    #            (layernorm f32 stats, gelu) — near-False FLOPs at a fraction
+    #            of the residual memory
+    remat: Any = False
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     use_bias: bool = True
 
@@ -175,27 +181,35 @@ def _layernorm(x, scale, bias, eps=1e-5):
 
 def _attention(q, k, v, cfg: GPT2Config):
     """q,k,v: [B, S, H, hd] → [B, S, H, hd], causal."""
+    from ray_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.current_mesh()
     impl = cfg.attention_impl
     if impl == "auto":
-        # TPU: the Pallas flash kernel (no S×S residuals → no full remat).
-        # Elsewhere: XLA einsum path (flash-in-interpret-mode is slow).
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # cp axis on the mesh → ring attention (sequence parallel). Otherwise
+        # TPU gets the Pallas flash kernel (no S×S residuals → no full remat)
+        # and other backends the XLA einsum path (flash-in-interpret is slow).
+        if mesh is not None and mesh.shape.get("cp", 1) > 1:
+            impl = "ring"
+        else:
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "pallas":
-        try:
-            from ray_tpu.ops.attention import flash_attention
-        except ImportError as e:
-            raise NotImplementedError(
-                "pallas flash attention kernel not available in this build"
-            ) from e
-        return flash_attention(q, k, v, causal=True)
+        from ray_tpu.ops.attention import flash_attention
+
+        interpret = None
+        if mesh is not None:
+            # decide off the mesh's devices, not the process default backend
+            interpret = mesh.devices.flat[0].platform != "tpu"
+        return flash_attention(q, k, v, causal=True, interpret=interpret)
     if impl == "ring":
-        try:
-            from ray_tpu.ops.ring_attention import ring_attention
-        except ImportError as e:
-            raise NotImplementedError(
-                "ring attention kernel not available in this build"
-            ) from e
-        return ring_attention(q, k, v, axis_name="cp", causal=True)
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+        if mesh is None:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh with a cp axis; call the "
+                "model inside parallel.mesh.use_mesh(mesh) (train_step does)"
+            )
+        return ring_attention_sharded(q, k, v, mesh, axis_name="cp", causal=True)
     # XLA path: einsum + mask; XLA fuses the softmax chain.
     S = q.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -230,7 +244,11 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.A
     x = wte[tokens] + params["wpe"][:S].astype(dt)
 
     block_fn = partial(_block, cfg=cfg)
-    if cfg.remat:
+    if cfg.remat == "dots":
+        block_fn = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.checkpoint_dots
+        )
+    elif cfg.remat:
         block_fn = jax.checkpoint(block_fn, static_argnums=())
 
     def scan_body(x, layer_params):

@@ -20,6 +20,8 @@ the public scaling literature.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -105,3 +107,23 @@ def data_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# Trace-time mesh context: model code that needs the mesh (e.g. GPT-2's ring
+# attention wraps a shard_map) reads it here; train_step enters the context
+# inside its jitted body so it is active whenever the step traces.
+_local = threading.local()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    prev = getattr(_local, "mesh", None)
+    _local.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _local.mesh = prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_local, "mesh", None)

@@ -83,11 +83,24 @@ class Checkpoint:
         if target is not None:
             try:
                 return ckptr.restore(ckpt_dir, target)
-            except Exception:  # noqa: BLE001
-                # Target tree structure doesn't match what was saved (e.g. the
-                # checkpoint wraps params under extra keys). Restore the saved
-                # structure as-is; caller unpacks.
-                pass
+            except Exception as targeted_err:  # noqa: BLE001
+                # Fall back to an untargeted restore ONLY for a structure
+                # mismatch (checkpoint wraps params under extra keys — caller
+                # unpacks). A genuinely corrupt/unreadable checkpoint fails
+                # both ways; surface the original error then instead of a
+                # confusing downstream shape error (advisor finding r2).
+                import logging
+
+                try:
+                    restored = ckptr.restore(ckpt_dir)
+                except Exception:
+                    raise targeted_err
+                logging.getLogger(__name__).warning(
+                    "targeted checkpoint restore failed (%s); restored saved "
+                    "structure WITHOUT the target's shardings",
+                    targeted_err,
+                )
+                return restored
         return ckptr.restore(ckpt_dir)
 
     def __repr__(self):

@@ -85,9 +85,12 @@ def make_gpt2_train_step(
 
     def step(state, batch):
         tokens, targets = batch["tokens"], batch["targets"]
-        loss, grads = jax.value_and_grad(gpt2.loss_fn)(
-            state["params"], tokens, targets, cfg
-        )
+        # use_mesh: active during tracing so the model can reach the mesh
+        # (ring attention wraps a shard_map over it).
+        with mesh_lib.use_mesh(mesh):
+            loss, grads = jax.value_and_grad(gpt2.loss_fn)(
+                state["params"], tokens, targets, cfg
+            )
         updates, new_opt = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

@@ -115,11 +115,13 @@ class DataParallelTrainer(BaseTrainer):
                         latest_ckpt,
                     )
                     error = self._drive(group, history)
-                except BaseException as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
                     # Worker-process death (ActorDiedError, rpc loss) must flow
                     # into the same FailureConfig retry loop as user-code errors
                     # — elastic restart-from-checkpoint is the whole point
                     # (reference: Tune trial FailureConfig handling).
+                    # KeyboardInterrupt/SystemExit are NOT retried: Ctrl-C must
+                    # stop training, not restart it (advisor finding r2).
                     error = e
                 if error is None:
                     metrics = history[-1] if history else None
@@ -165,7 +167,7 @@ class DataParallelTrainer(BaseTrainer):
         for w in group.workers:
             try:
                 ray_tpu.get(w.get_error.remote(), timeout=60)
-            except BaseException as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 return e
         return None
 

@@ -1,0 +1,129 @@
+"""Ring attention vs full attention (fwd + bwd) on the virtual CPU mesh.
+
+VERDICT r2 item 5 acceptance: ring == full attention on an 8-device mesh with
+cp >= 2, and a GPT-2 step running with a cp axis.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import mesh as mesh_lib
+
+
+def ref_attention(q, k, v, causal=True):
+    S, Skv = q.shape[1], k.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, Skv), dtype=bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+def make_qkv(key, B=2, S=256, H=4, hd=32, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return (
+        jax.random.normal(k1, (B, S, H, hd), dtype),
+        jax.random.normal(k2, (B, S, H, hd), dtype),
+        jax.random.normal(k3, (B, S, H, hd), dtype),
+    )
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_full_forward(cpu_mesh8, cp, causal):
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec.for_devices(8, cp=cp), cpu_mesh8
+    )
+    q, k, v = make_qkv(jax.random.PRNGKey(0))
+    out = jax.jit(
+        lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=causal, block_q=32, block_k=32
+        )
+    )(q, k, v)
+    ref = ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_matches_full_backward(cpu_mesh8):
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec.for_devices(8, cp=4), cpu_mesh8)
+    q, k, v = make_qkv(jax.random.PRNGKey(1), B=1, S=128, H=2, hd=32)
+
+    def loss_ring(q, k, v):
+        o = ring_attention_sharded(
+            q, k, v, mesh, causal=True, block_q=32, block_k=32
+        )
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(ref_attention(q, k, v).astype(jnp.float32)))
+
+    g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_ring_with_sharded_inputs(cpu_mesh8):
+    """Inputs already laid out with batch on (dp, fsdp) and seq on cp — the
+    exact activation sharding the GPT-2 train step produces."""
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec.for_devices(8, cp=2, fsdp=2), cpu_mesh8
+    )
+    q, k, v = make_qkv(jax.random.PRNGKey(2), B=4, S=128)
+    sh = NamedSharding(mesh, P(("dp", "fsdp"), "cp", None, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(
+        lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, block_q=32, block_k=32
+        )
+    )(q, k, v)
+    ref = ref_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_gpt2_train_step_with_cp(cpu_mesh8):
+    """Full GPT-2 train step over a mesh with cp=2: auto impl selects ring,
+    loss is finite and matches the same step on a single device."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.train.train_step import make_gpt2_train_step, synthetic_batch
+
+    cfg = gpt2.gpt2_tiny()
+    batch = synthetic_batch(cfg, global_batch=8)
+
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshSpec.for_devices(8, cp=2, tp=2, fsdp=2), cpu_mesh8
+    )
+    bundle = make_gpt2_train_step(cfg, mesh=mesh, rng=jax.random.PRNGKey(0))
+    state, metrics = bundle.step_fn(bundle.state, batch)
+    loss_cp = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss_cp)
+
+    ref_mesh = mesh_lib.single_device_mesh(cpu_mesh8[0])
+    ref_bundle = make_gpt2_train_step(
+        cfg, mesh=ref_mesh, rng=jax.random.PRNGKey(0)
+    )
+    _, ref_metrics = ref_bundle.step_fn(ref_bundle.state, batch)
+    loss_ref = float(jax.device_get(ref_metrics["loss"]))
+    assert abs(loss_cp - loss_ref) < 5e-3, (loss_cp, loss_ref)
