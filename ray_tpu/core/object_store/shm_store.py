@@ -41,6 +41,13 @@ def session_dir(session: str) -> str:
     return os.path.join(base, f"ray_tpu_{session}")
 
 
+def default_spill_root(shm_dir: str) -> str:
+    """Session-level spill root; each node spills into its own subdir (the
+    shm dir is shared by all raylets of a session on a host, so a per-node
+    close must not delete siblings' spilled objects)."""
+    return os.path.join("/tmp", "ray_tpu_spill", os.path.basename(shm_dir))
+
+
 class ShmBuffer:
     """A sealed object's mapped memory (context-managed, zero-copy)."""
 
@@ -117,13 +124,9 @@ class ShmClient:
 
     def destroy(self):
         shutil.rmtree(self.dir, ignore_errors=True)
-        # Also reclaim this session's default spill directory (ObjectDirectory
-        # derives it from the shm dir name) — spilled objects must not outlive
-        # the session (advisor finding r2).
-        shutil.rmtree(
-            os.path.join("/tmp", "ray_tpu_spill", os.path.basename(self.dir)),
-            ignore_errors=True,
-        )
+        # Also reclaim this session's default spill root (all nodes' subdirs)
+        # — spilled objects must not outlive the session (advisor finding r2).
+        shutil.rmtree(default_spill_root(self.dir), ignore_errors=True)
 
 
 @dataclass
@@ -143,16 +146,16 @@ class ObjectDirectory:
     """
 
     def __init__(self, client: ShmClient, capacity_bytes: int,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None, node_id: str = "node"):
         self.client = client
         self.capacity = capacity_bytes
         self.used = 0
         self.entries: Dict[ObjectID, _Entry] = {}
         # Spilling is the eviction safety net (eviction never destroys the
-        # only copy), so a spill dir always exists — default under /tmp next
-        # to the session's logs.
+        # only copy), so a spill dir always exists — default: a per-node
+        # subdir under the session spill root.
         self.spill_dir = spill_dir or os.path.join(
-            "/tmp", "ray_tpu_spill", os.path.basename(client.dir)
+            default_spill_root(client.dir), node_id
         )
         self.spilled: Dict[ObjectID, str] = {}
         self._lock = threading.Lock()

@@ -75,6 +75,7 @@ class Raylet:
         self.directory = ObjectDirectory(
             self.shm, cap_mb * 1024 * 1024,
             spill_dir=spill_dir or _config.object_spilling_dir or None,
+            node_id=self.node_id,
         )
         self.worker_env = worker_env or {}
         self.pool: Optional[WorkerPool] = None

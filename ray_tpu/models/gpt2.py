@@ -58,6 +58,10 @@ class GPT2Config:
             )
         if self.attention_impl not in ("auto", "xla", "pallas", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if not (isinstance(self.remat, bool) or self.remat == "dots"):
+            raise ValueError(
+                f"remat must be True, False, or 'dots'; got {self.remat!r}"
+            )
 
     @property
     def head_dim(self) -> int:

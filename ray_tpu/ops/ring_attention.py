@@ -127,8 +127,11 @@ def _ring_bwd(axis_name, causal, scale, block_q, block_k, interpret, res, do):
         dk = dk + dk_c.astype(jnp.float32)
         dv = dv + dv_c.astype(jnp.float32)
         # (dk, dv) travel with their kv chunk; the final rotation returns each
-        # chunk's gradient to its owning device (n rotations = identity for kv).
-        kk, vv, dk, dv = _rotate((kk, vv, dk, dv), axis_name, perm)
+        # chunk's gradient to its owning device — k/v themselves don't need it.
+        if step != n - 1:
+            kk, vv, dk, dv = _rotate((kk, vv, dk, dv), axis_name, perm)
+        else:
+            dk, dv = _rotate((dk, dv), axis_name, perm)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -1,0 +1,22 @@
+"""RLlib-equivalent RL stack, TPU-native.
+
+Rollouts are CPU actors (EnvRunner); SGD is a jitted/pjit-able JAX update in
+the learner (JaxLearner/LearnerGroup); algorithms (PPO, IMPALA) are Trainables
+so they run standalone or under Tune. See SURVEY.md §2.9/§3.5 for the
+reference structure this mirrors.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import JaxLearner, LearnerGroup, PPOLearner
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "EnvRunner",
+    "JaxLearner",
+    "LearnerGroup",
+    "PPOLearner",
+    "SampleBatch",
+]

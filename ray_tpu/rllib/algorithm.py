@@ -1,0 +1,255 @@
+"""Algorithm + AlgorithmConfig: the RLlib-equivalent driver layer.
+
+Parity: rllib/algorithms/algorithm.py:149 (`Algorithm(Trainable)` — every
+algorithm is Tune-runnable via train()/save()/restore()) and
+algorithm_config.py (fluent builder). `training_step()` is the per-iteration
+hook each algorithm implements (reference :1347).
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import deque
+from typing import Any, Dict, List, Optional, Type
+
+import numpy as np
+
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder (subset of the reference's ~300 knobs)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Optional[str] = None
+        self.num_envs_per_worker = 8
+        # rollouts
+        self.num_rollout_workers = 0  # 0 = sample inline in the driver process
+        self.rollout_fragment_length = 128
+        # training
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.lr = 3e-4
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 10
+        self.grad_clip = 0.5
+        self.hiddens = (64, 64)
+        self.seed = 0
+        # learner placement
+        self.learner_mode = "local"   # "local" | "remote" (one accelerator actor)
+        self.learner_remote_options: Dict[str, Any] = {"num_cpus": 1}
+        # extra per-algorithm knobs set by subclass-specific methods
+        self.extra: Dict[str, Any] = {}
+
+    # fluent sections, mirroring the reference's .environment()/.rollouts()/...
+    def environment(self, env: str, num_envs_per_worker: Optional[int] = None):
+        self.env = env
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        return self
+
+    def rollouts(self, num_rollout_workers: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if hasattr(self, k) and k != "extra":
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def resources(self, learner_mode: Optional[str] = None,
+                  learner_remote_options: Optional[Dict[str, Any]] = None):
+        if learner_mode is not None:
+            self.learner_mode = learner_mode
+        if learner_remote_options is not None:
+            self.learner_remote_options = learner_remote_options
+        return self
+
+    def debugging(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: v for k, v in vars(self).items() if k != "algo_class"}
+        return copy.deepcopy(d)
+
+    def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
+        for k, v in d.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig()/...")
+        return self.algo_class(config=self)
+
+
+class Algorithm(Trainable):
+    """Base driver: owns rollout workers + a LearnerGroup.
+
+    Subclasses implement training_step() returning per-iteration metrics.
+    Tune integration comes from Trainable (train/save/restore).
+    """
+
+    config_class: Type[AlgorithmConfig] = AlgorithmConfig
+
+    def __init__(self, config: Any = None):
+        if isinstance(config, AlgorithmConfig):
+            self.algo_config = config
+        else:
+            self.algo_config = self.config_class().update_from_dict(dict(config or {}))
+        self._episode_returns: deque = deque(maxlen=100)
+        self._episode_lengths: deque = deque(maxlen=100)
+        super().__init__(self.algo_config.to_dict())
+
+    # -- setup -------------------------------------------------------------- #
+    def setup(self, config: Dict[str, Any]) -> None:
+        from ray_tpu.rllib.env.vector_env import make_vector_env
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        cfg = self.algo_config
+        if cfg.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        probe = make_vector_env(cfg.env, 1)
+        self.obs_dim, self.num_actions = probe.obs_dim, probe.num_actions
+
+        runner_kwargs = dict(
+            env=cfg.env,
+            num_envs=cfg.num_envs_per_worker,
+            hiddens=tuple(cfg.hiddens),
+            gamma=cfg.gamma,
+            lambda_=cfg.lambda_,
+            seed=cfg.seed,
+        )
+        if cfg.num_rollout_workers > 0:
+            import ray_tpu
+
+            remote_runner = ray_tpu.remote(num_cpus=1)(EnvRunner)
+            self.workers = [
+                remote_runner.remote(worker_index=i + 1, **runner_kwargs)
+                for i in range(cfg.num_rollout_workers)
+            ]
+            self.local_runner = None
+        else:
+            self.workers = []
+            self.local_runner = EnvRunner(worker_index=0, **runner_kwargs)
+
+        self.learner_group = self._make_learner_group()
+        self._weights = self.learner_group.get_weights()
+
+    def _make_learner_group(self):
+        raise NotImplementedError
+
+    # -- rollout helpers ---------------------------------------------------- #
+    def _steps_per_round(self) -> int:
+        cfg = self.algo_config
+        n_runners = max(len(self.workers), 1)
+        return cfg.rollout_fragment_length * cfg.num_envs_per_worker * n_runners
+
+    def sample_batch(self):
+        """Synchronous parallel sampling across all runners.
+
+        Parity: rllib/execution/rollout_ops.py synchronous_parallel_sample.
+        Loops rounds of fragment-length rollouts until train_batch_size rows.
+        """
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        cfg = self.algo_config
+        batches: List[SampleBatch] = []
+        total = 0
+        while total < cfg.train_batch_size:
+            if self.workers:
+                import ray_tpu
+
+                weights_ref = ray_tpu.put(self._weights)
+                outs = ray_tpu.get([
+                    w.sample.remote(cfg.rollout_fragment_length, weights_ref)
+                    for w in self.workers
+                ])
+            else:
+                outs = [
+                    self.local_runner.sample(
+                        cfg.rollout_fragment_length, self._weights
+                    )
+                ]
+            for batch, metrics in outs:
+                batches.append(batch)
+                total += len(batch)
+                # dedupe against prior rounds: runners send their full rolling
+                # window; keep appending is fine since deque caps at 100 and
+                # ordering is stable
+                self._merge_episode_metrics(metrics)
+        return SampleBatch.concat_samples(batches)
+
+    def _merge_episode_metrics(self, metrics: Dict[str, Any]) -> None:
+        # runner sends its full rolling window each time; replace per worker
+        self._runner_windows = getattr(self, "_runner_windows", {})
+        self._runner_windows[metrics["worker_index"]] = (
+            metrics["episode_returns"], metrics["episode_lengths"]
+        )
+
+    def _episode_stats(self) -> Dict[str, Any]:
+        returns: List[float] = []
+        lengths: List[int] = []
+        for rets, lens in getattr(self, "_runner_windows", {}).values():
+            returns.extend(rets)
+            lengths.extend(lens)
+        if not returns:
+            return {"episode_reward_mean": float("nan"), "episodes_this_window": 0}
+        return {
+            "episode_reward_mean": float(np.mean(returns)),
+            "episode_reward_max": float(np.max(returns)),
+            "episode_reward_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean(lengths)),
+            "episodes_this_window": len(returns),
+        }
+
+    # -- Trainable ---------------------------------------------------------- #
+    def step(self) -> Dict[str, Any]:
+        result = self.training_step()
+        result.update(self._episode_stats())
+        return result
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        return {"learner_state": self.learner_group.get_state(),
+                "config": self.algo_config.to_dict()}
+
+    def load_checkpoint(self, checkpoint) -> None:
+        self.learner_group.set_state(checkpoint["learner_state"])
+        self._weights = self.learner_group.get_weights()
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        return False
+
+    def get_weights(self):
+        return self._weights
+
+    def cleanup(self) -> None:
+        if self.workers:
+            import ray_tpu
+
+            for w in self.workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass

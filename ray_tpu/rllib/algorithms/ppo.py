@@ -1,0 +1,62 @@
+"""PPO: synchronous sample → multi-epoch clipped-surrogate SGD → weight sync.
+
+Parity: rllib/algorithms/ppo/ppo.py:394 (`PPO`), training_step :420 —
+synchronous_parallel_sample across rollout workers, learner_group.update on
+the concatenated batch, then weights broadcast back to the workers. Tuned
+regression target: CartPole-v1 episode_reward_mean >= 150 within 100k steps
+(rllib/tuned_examples/ppo/cartpole-ppo.yaml:4-6) — tests/test_rllib_ppo.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import LearnerGroup, PPOLearner
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+
+    def training(self, **kwargs):
+        for k in ("clip_param", "vf_clip_param", "vf_loss_coeff", "entropy_coeff"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def _make_learner_group(self) -> LearnerGroup:
+        cfg = self.algo_config
+        learner_kwargs = dict(
+            obs_dim=self.obs_dim,
+            num_actions=self.num_actions,
+            hiddens=tuple(cfg.hiddens),
+            lr=cfg.lr,
+            grad_clip=cfg.grad_clip,
+            num_epochs=cfg.num_epochs,
+            minibatch_size=cfg.minibatch_size,
+            seed=cfg.seed,
+            clip_param=getattr(cfg, "clip_param", 0.2),
+            vf_clip_param=getattr(cfg, "vf_clip_param", 10.0),
+            vf_loss_coeff=getattr(cfg, "vf_loss_coeff", 0.5),
+            entropy_coeff=getattr(cfg, "entropy_coeff", 0.01),
+        )
+        return LearnerGroup(
+            PPOLearner, learner_kwargs, mode=cfg.learner_mode,
+            remote_options=cfg.learner_remote_options,
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        train_batch = self.sample_batch()
+        metrics = self.learner_group.update(train_batch)
+        self._weights = self.learner_group.get_weights()
+        metrics["timesteps_this_iter"] = len(train_batch)
+        return metrics

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.env.vector_env import VectorEnv, make_vector_env
+from ray_tpu.rllib.env.cartpole import CartPoleVectorEnv
+
+__all__ = ["VectorEnv", "make_vector_env", "CartPoleVectorEnv"]

@@ -1,0 +1,172 @@
+"""EnvRunner: the rollout worker actor.
+
+Parity: rllib/evaluation/rollout_worker.py:166 (`RolloutWorker`) +
+env_runner_v2.py:199 — an actor that owns a vector env and a policy copy,
+produces GAE-postprocessed SampleBatches. TPU-native topology: runners are CPU
+actors (the env is host code); the policy forward pass is a jitted JAX fn so
+the same module weights move runner <-> learner as a host pytree.
+
+Used via `ray_tpu.remote(EnvRunner)` by the Algorithm (see algorithms/ppo.py);
+also usable inline for tests.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.env.vector_env import make_vector_env
+from ray_tpu.rllib.models import (
+    categorical_logp,
+    categorical_sample,
+    mlp_actor_critic_apply,
+    mlp_actor_critic_init,
+)
+from ray_tpu.rllib.postprocessing import compute_gae_lanes
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class EnvRunner:
+    def __init__(
+        self,
+        env: str,
+        num_envs: int = 8,
+        hiddens=(64, 64),
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        import jax
+
+        self.env = make_vector_env(env, num_envs)
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self.worker_index = worker_index
+        self._rng_key = jax.random.PRNGKey(seed * 10_007 + worker_index)
+        self.params = mlp_actor_critic_init(
+            self._rng_key, self.env.obs_dim, self.env.num_actions, hiddens
+        )
+
+        def _act(params, obs, key):
+            logits, value = mlp_actor_critic_apply(params, obs)
+            actions = categorical_sample(key, logits)
+            logp = categorical_logp(logits, actions)
+            return actions, logp, value
+
+        def _value(params, obs):
+            return mlp_actor_critic_apply(params, obs)[1]
+
+        # rollout inference always runs on host CPU (the env is host code and
+        # the accelerator belongs to the learner); sample() enters
+        # jax.default_device(cpu) so uncommitted numpy inputs land there
+        self._cpu = jax.devices("cpu")[0]
+        self._act = jax.jit(_act)
+        self._value = jax.jit(_value)
+
+        self._obs = self.env.reset(seed=seed * 997 + worker_index)
+        # per-lane running episode return/length + completed-episode history
+        self._ep_ret = np.zeros(num_envs, np.float32)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._episode_returns: deque = deque(maxlen=100)
+        self._episode_lengths: deque = deque(maxlen=100)
+        self._eps_base = worker_index * 1_000_000_000
+        self._eps_id = np.arange(num_envs, dtype=np.int64) + self._eps_base
+        self._next_eps = num_envs
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def get_weights(self):
+        return self.params
+
+    def obs_space(self) -> Tuple[int, int]:
+        return self.env.obs_dim, self.env.num_actions
+
+    def sample(
+        self, num_steps: int, params: Optional[Any] = None
+    ) -> Tuple[SampleBatch, Dict[str, Any]]:
+        """Roll `num_steps` env steps per lane; return (batch, metrics).
+
+        Batch rows are time-major flattened ([T*N]) with GAE advantages and
+        value targets already attached.
+        """
+        import jax
+
+        if params is not None:
+            self.params = params
+        ctx = jax.default_device(self._cpu)
+        with ctx:
+            return self._sample(num_steps)
+
+    def _sample(self, num_steps: int) -> Tuple[SampleBatch, Dict[str, Any]]:
+        import jax
+
+        N = self.env.num_envs
+        T = num_steps
+        obs_buf = np.empty((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.empty((T, N), np.int64)
+        logp_buf = np.empty((T, N), np.float32)
+        vf_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), bool)
+        trunc_buf = np.empty((T, N), bool)
+        eps_buf = np.empty((T, N), np.int64)
+
+        obs = self._obs
+        for t in range(T):
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            actions, logp, value = self._act(self.params, obs, sub)
+            actions = np.asarray(actions)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            vf_buf[t] = np.asarray(value)
+            eps_buf[t] = self._eps_id
+            obs, rewards, terminated, truncated = self.env.step(actions)
+            rew_buf[t] = rewards
+            term_buf[t] = terminated
+            trunc_buf[t] = truncated
+            self._ep_ret += rewards
+            self._ep_len += 1
+            done = terminated | truncated
+            if done.any():
+                for i in np.flatnonzero(done):
+                    self._episode_returns.append(float(self._ep_ret[i]))
+                    self._episode_lengths.append(int(self._ep_len[i]))
+                    self._eps_id[i] = self._eps_base + self._next_eps
+                    self._next_eps += 1
+                self._ep_ret[done] = 0.0
+                self._ep_len[done] = 0
+        self._obs = obs
+
+        bootstrap = np.asarray(self._value(self.params, obs))
+        advantages, value_targets = compute_gae_lanes(
+            rew_buf, vf_buf, bootstrap, term_buf, trunc_buf,
+            gamma=self.gamma, lambda_=self.lambda_,
+        )
+
+        def flat(x):
+            return x.reshape((T * N,) + x.shape[2:])
+
+        batch = SampleBatch({
+            SampleBatch.OBS: flat(obs_buf),
+            SampleBatch.ACTIONS: flat(act_buf),
+            SampleBatch.REWARDS: flat(rew_buf),
+            SampleBatch.TERMINATEDS: flat(term_buf),
+            SampleBatch.TRUNCATEDS: flat(trunc_buf),
+            SampleBatch.ACTION_LOGP: flat(logp_buf),
+            SampleBatch.VF_PREDS: flat(vf_buf),
+            SampleBatch.ADVANTAGES: flat(advantages),
+            SampleBatch.VALUE_TARGETS: flat(value_targets),
+            SampleBatch.EPS_ID: flat(eps_buf),
+        })
+        metrics = {
+            "episode_returns": list(self._episode_returns),
+            "episode_lengths": list(self._episode_lengths),
+            "num_env_steps": T * N,
+            "worker_index": self.worker_index,
+        }
+        return batch, metrics

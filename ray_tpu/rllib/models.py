@@ -1,0 +1,72 @@
+"""RLModule-equivalent: pure-function JAX actor-critic networks.
+
+Parity: rllib/core/rl_module/rl_module.py:221 (`RLModule`) — the reference's
+new-stack module holds a torch net with forward_exploration/forward_train.
+TPU-first shape: a module is (init, apply) pure functions over a params pytree,
+so the same apply runs jitted inside the rollout actor (CPU) and inside the
+pjit'd learner update (TPU mesh) with zero glue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_actor_critic_init(
+    rng: jax.Array,
+    obs_dim: int,
+    num_actions: int,
+    hiddens: Sequence[int] = (64, 64),
+) -> Dict[str, Any]:
+    """Shared-nothing torso: separate pi and vf MLPs (RLlib's default for PG)."""
+    params: Dict[str, Any] = {}
+    for head, out_dim in (("pi", num_actions), ("vf", 1)):
+        keys = jax.random.split(jax.random.fold_in(rng, hash(head) % 2**31), len(hiddens) + 1)
+        sizes = [obs_dim, *hiddens]
+        layers = []
+        for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+            w = jax.random.normal(keys[i], (din, dout)) * np.sqrt(2.0 / din)
+            layers.append({"w": w.astype(jnp.float32), "b": jnp.zeros((dout,), jnp.float32)})
+        # small final layer: near-uniform initial policy / near-zero values
+        w = jax.random.normal(keys[-1], (sizes[-1], out_dim)) * 0.01
+        layers.append({"w": w.astype(jnp.float32), "b": jnp.zeros((out_dim,), jnp.float32)})
+        params[head] = layers
+    return params
+
+
+def _mlp_forward(layers, x):
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+def mlp_actor_critic_apply(
+    params: Dict[str, Any], obs: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, obs_dim] → (logits [B, A], value [B])."""
+    logits = _mlp_forward(params["pi"], obs)
+    value = _mlp_forward(params["vf"], obs)[..., 0]
+    return logits, value
+
+
+# --------------------------------------------------------------------------- #
+# Categorical action distribution
+# --------------------------------------------------------------------------- #
+
+def categorical_sample(rng: jax.Array, logits: jax.Array) -> jax.Array:
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def categorical_logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)

@@ -1,0 +1,93 @@
+"""SampleBatch: columnar rollout storage for the RL stack.
+
+Parity: rllib/policy/sample_batch.py:96 (`SampleBatch`) — a dict of columns
+(numpy arrays) with standard keys, concat/shuffle/minibatch utilities. Ours is
+numpy-only on the host; batches cross the wire through the object store and are
+`device_put` on the learner side (columns are contiguous so the transfer is
+zero-copy out of shm).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Sequence
+
+import numpy as np
+
+
+class SampleBatch(dict):
+    """A dict of equally-long numpy columns. Length = first dim of any column."""
+
+    OBS = "obs"
+    NEXT_OBS = "next_obs"
+    ACTIONS = "actions"
+    REWARDS = "rewards"
+    TERMINATEDS = "terminateds"
+    TRUNCATEDS = "truncateds"
+    ACTION_LOGP = "action_logp"
+    VF_PREDS = "vf_preds"
+    ADVANTAGES = "advantages"
+    VALUE_TARGETS = "value_targets"
+    EPS_ID = "eps_id"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in list(self.items()):
+            if not isinstance(v, np.ndarray):
+                self[k] = np.asarray(v)
+        lens = {len(v) for v in self.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged SampleBatch columns: { {k: len(v) for k, v in self.items()} }")
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def take(self, indices: np.ndarray) -> "SampleBatch":
+        return SampleBatch({k: v[indices] for k, v in self.items()})
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(len(self))
+        return self.take(perm)
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = len(self)
+        for start in range(0, n - size + 1, size):
+            yield self.slice(start, start + size)
+
+    @staticmethod
+    def concat_samples(batches: Sequence["SampleBatch"]) -> "SampleBatch":
+        batches = [b for b in batches if len(b) > 0]
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch(
+            {k: np.concatenate([b[k] for b in batches], axis=0) for k in keys}
+        )
+
+    def split_by_episode(self) -> List["SampleBatch"]:
+        """Split on EPS_ID boundaries (rows must be grouped by episode)."""
+        if self.EPS_ID not in self or len(self) == 0:
+            return [self]
+        eps = self[self.EPS_ID]
+        cuts = np.flatnonzero(eps[1:] != eps[:-1]) + 1
+        out, prev = [], 0
+        for c in list(cuts) + [len(self)]:
+            out.append(self.slice(prev, c))
+            prev = c
+        return out
+
+    def as_jax(self, device=None) -> Dict[str, "object"]:
+        import jax
+
+        arrays = {k: v for k, v in self.items()}
+        if device is not None:
+            return jax.device_put(arrays, device)
+        return arrays
