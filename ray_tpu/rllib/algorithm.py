@@ -245,6 +245,8 @@ class Algorithm(Trainable):
         return self._weights
 
     def cleanup(self) -> None:
+        if getattr(self, "learner_group", None) is not None:
+            self.learner_group.shutdown()
         if self.workers:
             import ray_tpu
 

@@ -280,3 +280,15 @@ class LearnerGroup:
             import ray_tpu
 
             ray_tpu.get(self._actor.set_state.remote(state))
+
+    def shutdown(self) -> None:
+        """Kill the remote learner actor (it owns the accelerator — leaking it
+        would keep the TPU locked for the next trial)."""
+        if self._actor is not None:
+            import ray_tpu
+
+            try:
+                ray_tpu.kill(self._actor)
+            except Exception:  # noqa: BLE001 - already dead / shutdown race
+                pass
+            self._actor = None

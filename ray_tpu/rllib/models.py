@@ -24,8 +24,8 @@ def mlp_actor_critic_init(
 ) -> Dict[str, Any]:
     """Shared-nothing torso: separate pi and vf MLPs (RLlib's default for PG)."""
     params: Dict[str, Any] = {}
-    for head, out_dim in (("pi", num_actions), ("vf", 1)):
-        keys = jax.random.split(jax.random.fold_in(rng, hash(head) % 2**31), len(hiddens) + 1)
+    for head_idx, (head, out_dim) in enumerate((("pi", num_actions), ("vf", 1))):
+        keys = jax.random.split(jax.random.fold_in(rng, head_idx), len(hiddens) + 1)
         sizes = [obs_dim, *hiddens]
         layers = []
         for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):

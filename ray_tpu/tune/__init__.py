@@ -1,0 +1,44 @@
+"""Tune-equivalent hyperparameter search layer.
+
+Trials are actors; the controller is an event loop over wait(); schedulers
+(ASHA, PBT) prune/exploit mid-flight. See SURVEY.md §2.7.
+"""
+
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tune_controller import TuneController
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Trainable",
+    "Trial",
+    "TuneConfig",
+    "TuneController",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "sample_from",
+    "uniform",
+    "wrap_function",
+]

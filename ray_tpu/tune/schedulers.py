@@ -1,0 +1,174 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), PBT.
+
+Parity: tune/schedulers/ — async_hyperband.py (`AsyncHyperBandScheduler`)
+and pbt.py:216 (`PopulationBasedTraining`). The controller calls
+`on_result(trial, result)` per report; the scheduler answers CONTINUE / STOP /
+and (PBT) requests an exploit via `ExploitDecision`.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+@dataclass
+class ExploitDecision:
+    """PBT: `trial` should load `source`'s checkpoint and adopt `new_config`."""
+
+    source: Any          # Trial to clone from
+    new_config: Dict[str, Any]
+
+
+class TrialScheduler:
+    def on_result(self, trial, result: Dict[str, Any]):
+        return CONTINUE
+
+    def choose_metric(self, metric: str, mode: str) -> None:
+        self.metric, self.mode = metric, mode
+
+    def _score(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving: rungs at max_t/rf^k; a trial reaching a rung
+    survives only if in the top 1/rf of results recorded at that rung.
+
+    Parity: tune/schedulers/async_hyperband.py (`_Bracket.on_result`).
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung milestones ascending: grace, grace*rf, grace*rf^2, ... < max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        self._trial_rung: Dict[str, int] = {}  # highest rung already recorded
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        score = self._score(float(value))
+        # record once per rung crossing (reference _Bracket.on_result): each
+        # trial contributes exactly one score per rung, judged at that moment
+        done_rung = self._trial_rung.get(trial.trial_id, 0)
+        for rung in reversed(self.rungs):
+            if t >= rung > done_rung:
+                self._trial_rung[trial.trial_id] = rung
+                scores = self.recorded[rung]
+                scores.append(score)
+                k = max(1, len(scores) // self.rf)
+                cutoff = sorted(scores, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+                break
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: bottom-quantile trials exploit a top-quantile trial's checkpoint
+    and explore a perturbed copy of its hyperparameters.
+
+    Parity: tune/schedulers/pbt.py:216 — perturbation_interval in time_attr
+    units; explore = resample from `hyperparam_mutations` (callable/list) or
+    perturb numeric values by ×1.2 / ×0.8.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._trials: List[Any] = []
+        self.num_perturbations = 0
+
+    def on_trial_add(self, trial) -> None:
+        self._trials.append(trial)
+
+    def _quantiles(self):
+        scored = [
+            t for t in self._trials
+            if t.metric(self.metric) is not None and t.status not in ("TERMINATED", "ERROR")
+        ]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda t: self._score(float(t.metric(self.metric))))
+        n = max(1, int(math.ceil(len(scored) * self.quantile)))
+        if n > len(scored) / 2:
+            n = len(scored) // 2
+        if n == 0:
+            return [], []
+        return scored[:n], scored[-n:]
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                if self.rng.random() < self.resample_prob or key not in new:
+                    new[key] = self.rng.choice(spec)
+                else:
+                    idx = spec.index(new[key]) if new[key] in spec else 0
+                    shift = self.rng.choice([-1, 1])
+                    new[key] = spec[max(0, min(len(spec) - 1, idx + shift))]
+            elif callable(spec):
+                if self.rng.random() < self.resample_prob or key not in new:
+                    new[key] = spec()
+                else:
+                    new[key] = new[key] * self.rng.choice([0.8, 1.2])
+            else:
+                raise ValueError(
+                    f"hyperparam_mutations[{key!r}] must be a list or callable"
+                )
+        return new
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        bottom, top = self._quantiles()
+        if trial in bottom:
+            source = self.rng.choice(top)
+            self.num_perturbations += 1
+            return ExploitDecision(
+                source=source, new_config=self.explore(source.config)
+            )
+        return CONTINUE

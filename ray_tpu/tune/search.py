@@ -1,0 +1,139 @@
+"""Search spaces + search algorithms (grid/random; plugin seam for others).
+
+Parity: python/ray/tune/search/ — sample.py domains (uniform/loguniform/
+choice/randint/grid_search) and basic_variant.py (`BasicVariantGenerator`:
+cross product of grid axes × num_samples random draws).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        assert low > 0 and high > 0
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class GridSearch:
+    """Marker for an exhaustive axis (not a Domain: grid axes multiply trials)."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn) -> "SampleFrom":
+    return SampleFrom(fn)
+
+
+class SampleFrom(Domain):
+    """Callable domain: fn(config_so_far) or fn() → value."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):
+        try:
+            return self.fn()
+        except TypeError:
+            return self.fn({})
+
+
+class SearchAlgorithm:
+    """Yields trial configs. next_config() returns None when exhausted."""
+
+    def configs(self) -> Iterator[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]) -> None:
+        pass
+
+
+class BasicVariantGenerator(SearchAlgorithm):
+    """Grid cross-product × num_samples random resolutions.
+
+    Parity: tune/search/basic_variant.py — each of the `num_samples` repeats
+    expands every GridSearch axis exhaustively; Domain leaves are sampled
+    independently per generated config.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def configs(self) -> Iterator[Dict[str, Any]]:
+        grid_axes = {
+            k: v.values for k, v in self.param_space.items()
+            if isinstance(v, GridSearch)
+        }
+        keys = list(grid_axes)
+        combos = list(itertools.product(*grid_axes.values())) if keys else [()]
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    else:
+                        cfg[k] = v
+                yield cfg

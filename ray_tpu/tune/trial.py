@@ -1,0 +1,46 @@
+"""Trial: one hyperparameter configuration's lifecycle.
+
+Parity: tune/experiment/trial.py:282 (`class Trial`) — status machine
+PENDING → RUNNING → (PAUSED ↔ RUNNING) → TERMINATED | ERROR, with per-trial
+result history and checkpoint tracking. Each trial runs as one actor.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    config: Dict[str, Any]
+    trial_id: str = field(default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    results: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_dir: Optional[str] = None
+    error: Optional[str] = None
+    actor: Any = None           # ActorHandle while RUNNING/PAUSED
+    inflight: Any = None        # ObjectRef of the pending train() call
+
+    @property
+    def last_result(self) -> Optional[Dict[str, Any]]:
+        return self.results[-1] if self.results else None
+
+    @property
+    def iteration(self) -> int:
+        r = self.last_result
+        return int(r.get("training_iteration", 0)) if r else 0
+
+    def metric(self, name: str, default=None):
+        r = self.last_result
+        return r.get(name, default) if r else default
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status}, it={self.iteration})"

@@ -1,0 +1,235 @@
+"""TuneController: the event-driven trial execution loop.
+
+Parity: tune/execution/tune_controller.py:49 (`TuneController`, step loop
+:267) over RayActorManager (air/execution/_internal/actor_manager.py:23).
+Each trial is one actor built from the Trainable; the controller advances
+whichever trial finishes an iteration first (`wait(num_returns=1)`), feeds the
+scheduler, and executes its decisions — including PBT exploits, which ship
+checkpoints between actors through the object store.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import tarfile
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    ExploitDecision,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.trial import Trial
+
+logger = logging.getLogger(__name__)
+
+
+def _pack_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=".")
+    return buf.getvalue()
+
+
+def _unpack_dir(data: bytes, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
+        tar.extractall(path, filter="data")
+
+
+class _TrialRunner:
+    """Actor wrapping one Trainable instance (the per-trial process).
+
+    Checkpoints cross actors as packed bytes via the object store, so PBT
+    exploits work across nodes without a shared filesystem.
+    """
+
+    def __init__(self, trainable_cls, config):
+        self._trainable = trainable_cls(config)
+
+    def train(self):
+        return self._trainable.train()
+
+    def save_to_object(self) -> bytes:
+        d = tempfile.mkdtemp(prefix="tune_ckpt_")
+        self._trainable.save(d)
+        return _pack_dir(d)
+
+    def restore_from_object(self, data: bytes) -> None:
+        d = tempfile.mkdtemp(prefix="tune_ckpt_")
+        _unpack_dir(data, d)
+        self._trainable.restore(d)
+
+    def reset_config(self, new_config) -> bool:
+        handled = self._trainable.reset_config(new_config)
+        if handled:
+            self._trainable.config = dict(new_config)
+        return handled
+
+    def stop(self):
+        self._trainable.stop()
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable_cls,
+        trials: List[Trial],
+        *,
+        metric: str,
+        mode: str = "max",
+        scheduler: Optional[TrialScheduler] = None,
+        max_concurrent: int = 8,
+        stop: Optional[Dict[str, Any]] = None,
+        trial_resources: Optional[Dict[str, float]] = None,
+        trial_wait_timeout_s: Optional[float] = None,
+    ):
+        assert mode in ("min", "max")
+        self.trainable_cls = trainable_cls
+        self.trials = trials
+        self.metric = metric
+        self.mode = mode
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.choose_metric(metric, mode)
+        self.max_concurrent = max_concurrent
+        self.stop_criteria = stop or {}
+        self.trial_resources = trial_resources or {"num_cpus": 1}
+        self.trial_wait_timeout_s = trial_wait_timeout_s
+        for t in trials:
+            if hasattr(self.scheduler, "on_trial_add"):
+                self.scheduler.on_trial_add(t)
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> List[Trial]:
+        import ray_tpu
+
+        self._remote_cls = ray_tpu.remote(**self.trial_resources)(_TrialRunner)
+        try:
+            while not self._finished():
+                self._start_pending()
+                self._step()
+        finally:
+            for t in self.trials:
+                self._terminate(t, status=t.status if t.status in (
+                    trial_mod.TERMINATED, trial_mod.ERROR) else trial_mod.TERMINATED)
+        return self.trials
+
+    def _finished(self) -> bool:
+        return all(
+            t.status in (trial_mod.TERMINATED, trial_mod.ERROR)
+            for t in self.trials
+        )
+
+    def _running(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == trial_mod.RUNNING]
+
+    def _start_pending(self) -> None:
+        for t in self.trials:
+            if len(self._running()) >= self.max_concurrent:
+                break
+            if t.status == trial_mod.PENDING:
+                self._start_trial(t)
+
+    def _start_trial(self, t: Trial) -> None:
+        t.actor = self._remote_cls.remote(self.trainable_cls, t.config)
+        t.status = trial_mod.RUNNING
+        t.inflight = t.actor.train.remote()
+
+    def _step(self) -> None:
+        """Advance whichever running trial reports first."""
+        import ray_tpu
+
+        running = self._running()
+        if not running:
+            return
+        refs = [t.inflight for t in running]
+        # default: block until some trial reports (TPU iterations can be long)
+        ready, _ = ray_tpu.wait(
+            refs, num_returns=1, timeout=self.trial_wait_timeout_s
+        )
+        if not ready:
+            raise TimeoutError(
+                f"no trial reported within {self.trial_wait_timeout_s}s"
+            )
+        t = running[refs.index(ready[0])]
+        try:
+            result = ray_tpu.get(ready[0])
+        except Exception as e:  # noqa: BLE001 - trial actor died / user error
+            logger.warning("trial %s errored: %s", t.trial_id, e)
+            t.status = trial_mod.ERROR
+            t.error = str(e)
+            self._terminate(t, status=trial_mod.ERROR)
+            return
+        t.results.append(result)
+
+        if self._hit_stop_criteria(result) or result.get("done"):
+            self._terminate(t)
+            return
+        decision = self.scheduler.on_result(t, result)
+        if isinstance(decision, ExploitDecision):
+            self._exploit(t, decision)
+        elif decision == STOP:
+            self._terminate(t)
+        else:
+            t.inflight = t.actor.train.remote()
+
+    def _hit_stop_criteria(self, result: Dict[str, Any]) -> bool:
+        for key, bound in self.stop_criteria.items():
+            v = result.get(key)
+            if v is None:
+                continue
+            if key == self.metric and self.mode == "min":
+                if v <= bound:
+                    return True
+            elif v >= bound:
+                return True
+        return False
+
+    def _exploit(self, t: Trial, decision: ExploitDecision) -> None:
+        """PBT exploit: clone source's checkpoint into t, adopt mutated config.
+
+        Parity: tune/schedulers/pbt.py `_exploit` — checkpoint via object
+        store; reset_config in place when the trainable supports it, else
+        restart the actor with the new config.
+        """
+        import ray_tpu
+
+        src = decision.source
+        ckpt = ray_tpu.get(src.actor.save_to_object.remote())
+        handled = ray_tpu.get(t.actor.reset_config.remote(decision.new_config))
+        if handled:
+            ray_tpu.get(t.actor.restore_from_object.remote(ckpt))
+        else:
+            self._kill_actor(t)
+            t.actor = self._remote_cls.remote(
+                self.trainable_cls, decision.new_config
+            )
+            ray_tpu.get(t.actor.restore_from_object.remote(ckpt))
+        t.config = dict(decision.new_config)
+        t.inflight = t.actor.train.remote()
+
+    def _terminate(self, t: Trial, status: str = trial_mod.TERMINATED) -> None:
+        if t.actor is not None:
+            self._kill_actor(t)
+        if t.status not in (trial_mod.ERROR,):
+            t.status = status
+        t.inflight = None
+
+    def _kill_actor(self, t: Trial) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.get(t.actor.stop.remote(), timeout=10)
+        except Exception:  # noqa: BLE001 - best effort
+            pass
+        try:
+            ray_tpu.kill(t.actor)
+        except Exception:  # noqa: BLE001
+            pass
+        t.actor = None

@@ -1,0 +1,115 @@
+"""Tuner: the user-facing Tune API.
+
+Parity: tune/tuner.py:53 (`Tuner(trainable, param_space=..., tune_config=...,
+run_config=...).fit() → ResultGrid`) and tune/tune.py:293 (`tune.run`).
+Accepts a Trainable subclass, a plain function (wrapped via wrap_function), or
+a Train BaseTrainer (wrapped the way base_trainer.py:559 runs fit as a
+1-trial experiment).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.trial import ERROR, TERMINATED, Trial
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "score"
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 8
+    scheduler: Optional[TrialScheduler] = None
+    search_seed: Optional[int] = None
+    # how long fit() waits for any trial to report one iteration before
+    # aborting the experiment; None = wait indefinitely
+    trial_wait_timeout_s: Optional[float] = None
+
+
+@dataclass
+class ResultGrid:
+    trials: List[Trial]
+    metric: str
+    mode: str
+
+    def get_best_result(self) -> Trial:
+        done = [t for t in self.trials if t.last_result is not None]
+        if not done:
+            raise RuntimeError("no trial produced a result")
+        sign = 1 if self.mode == "max" else -1
+        return max(done, key=lambda t: sign * float(t.metric(self.metric, float("-inf") * sign)))
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for t in self.trials if t.status == ERROR)
+
+    def __iter__(self):
+        return iter(self.trials)
+
+    def __len__(self):
+        return len(self.trials)
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Any,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[Any] = None,   # train.RunConfig (stop criteria)
+        trial_resources: Optional[Dict[str, float]] = None,
+    ):
+        self.trainable_cls = _as_trainable_cls(trainable)
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+        self.trial_resources = trial_resources
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        gen = BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples, seed=tc.search_seed
+        )
+        trials = [Trial(config=cfg) for cfg in gen.configs()]
+        stop = getattr(self.run_config, "stop", None) or {}
+        controller = TuneController(
+            self.trainable_cls,
+            trials,
+            metric=tc.metric,
+            mode=tc.mode,
+            scheduler=tc.scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            stop=stop,
+            trial_resources=self.trial_resources,
+            trial_wait_timeout_s=tc.trial_wait_timeout_s,
+        )
+        controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+
+def _as_trainable_cls(trainable: Any) -> type:
+    """Function → FunctionTrainable; BaseTrainer → 1-trial wrapper; class →
+    itself."""
+    if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+        return trainable
+    # Train BaseTrainer instance: run trainer.fit() inside the trial, merging
+    # the trial config into train_loop_config (parity: base_trainer.py:559).
+    from ray_tpu.train.trainer import BaseTrainer
+
+    if isinstance(trainable, BaseTrainer):
+        return wrap_function(trainable.as_trainable())
+    if callable(trainable):
+        return wrap_function(trainable)
+    raise TypeError(f"cannot make a Trainable from {trainable!r}")

@@ -42,6 +42,26 @@ def test_causality():
     assert not np.allclose(l1[0, -1], l2[0, -1])
 
 
+def test_chunked_loss_matches_monolithic():
+    """The blockwise cross-entropy (loss_chunk) must equal the full-logits
+    path exactly (same math, f32 softmax) — value and gradients."""
+    cfg_m = gpt2.gpt2_tiny(loss_chunk=0, seq_len=256)
+    cfg_c = gpt2.gpt2_tiny(loss_chunk=64, seq_len=256)
+    params = gpt2.init(cfg_m, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg_m.vocab_size, (2, 256)).astype(np.int32)
+    tgt = np.roll(toks, -1, 1).copy()
+    tgt[:, -1] = -1
+    tgt[0, 5:9] = -1  # masked rows exercised
+    l1, g1 = jax.value_and_grad(gpt2.loss_fn)(params, toks, tgt, cfg_m)
+    l2, g2 = jax.value_and_grad(gpt2.loss_fn)(params, toks, tgt, cfg_c)
+    assert float(abs(l1 - l2)) < 1e-5
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-4
+        )
+
+
 def test_loss_decreases_single_device():
     cfg = gpt2.gpt2_tiny()
     bundle = make_gpt2_train_step(
