@@ -52,6 +52,9 @@ class Config:
     health_check_failure_threshold: int = 5
     gcs_rpc_timeout_s: float = 30.0
     actor_restart_backoff_s: float = 0.5
+    # max pipelined in-flight calls per actor (reference seq-no pipelining,
+    # direct_actor_task_submitter.h; 1 = strict await-each-response)
+    actor_max_inflight_calls: int = 64
 
     # --- workers ------------------------------------------------------------
     num_workers_soft_limit: int = 0  # 0 = num_cpus

@@ -101,16 +101,34 @@ class CoreWorker:
         self.io = rpc.EventLoopThread(name="ray-tpu-io")
         self.memory_store = _MemoryStore(self.io.loop)
         self.shm = ShmClient(session)
-        # ownership tables
+        # ownership tables (reference_count.h:61 ownership model)
         self.locations: Dict[ObjectID, dict] = {}     # owned shm objects
         self.submitted_specs: Dict[TaskID, ts.TaskSpec] = {}  # lineage
+        # oid → {"pending": tasks holding it as an arg, "borrowers": addrs}
+        self._owned: Dict[bytes, dict] = {}
+        self._task_arg_pins: Dict[TaskID, List[bytes]] = {}
+        self._return_oid_task: Dict[bytes, TaskID] = {}
+        self._reported_borrows: set = set()           # borrower side
+        self._reconstructing: Dict[bytes, asyncio.Event] = {}  # by task_id
+        self._reconstruct_attempts: Dict[bytes, int] = {}      # by task_id
+        # results granted to us as borrows, pinned by the outer return oid
+        # until released (see _store_task_result / _maybe_free)
+        self._granting_outers: Dict[bytes, set] = {}   # inner → outer keys
+        self._granted_by_outer: Dict[bytes, set] = {}  # outer → inner keys
+        self._granted_owner: Dict[bytes, str] = {}     # inner → owner addr
+        self._early_borrow_releases: Dict[bytes, set] = {}  # release-before-add
+        # observability: buffered task events, flushed to GCS periodically
+        # (task_event_buffer.h:193)
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
         self._fn_cache: Dict[bytes, Any] = {}
         self._registered_fns: set = set()
         self._actor_addr_cache: Dict[bytes, str] = {}
-        self._actor_queues: Dict[bytes, asyncio.Queue] = {}
+        self._actor_queues: Dict[bytes, "_ActorSubmitState"] = {}
         self._actor_conns: Dict[str, rpc.Connection] = {}
         self._worker_conns: Dict[str, rpc.Connection] = {}
         self._raylet_conns: Dict[str, rpc.Connection] = {}
+        self._conn_locks: Dict[tuple, asyncio.Lock] = {}
         self.server: Optional[rpc.RpcServer] = None
         self.gcs: Optional[rpc.Connection] = None
         self.raylet: Optional[rpc.Connection] = None
@@ -120,6 +138,9 @@ class CoreWorker:
     # ------------------------------------------------------------ lifecycle
     def connect(self):
         self.io.run(self._connect_async(), timeout=60)
+        from ray_tpu.core import refs as refs_mod
+
+        refs_mod.set_on_zero_callback(self._on_local_refs_zero)
         return self
 
     async def _connect_async(self):
@@ -138,8 +159,12 @@ class CoreWorker:
             )
         if self.mode == "driver":
             await self.gcs.call("register_driver")
+        asyncio.ensure_future(self._flush_task_events_loop())
 
     def shutdown(self):
+        from ray_tpu.core import refs as refs_mod
+
+        refs_mod.set_on_zero_callback(None)
         try:
             self.io.run(self._shutdown_async(), timeout=10)
         except Exception:  # noqa: BLE001
@@ -190,6 +215,7 @@ class CoreWorker:
         oid = ObjectID.for_put(self.worker_id)
         data = serialization.serialize(value).to_bytes()
         ref = ObjectRef(oid, owner_addr=self.address)
+        self._own(oid)
         if len(data) <= _config.max_direct_call_object_size:
             self.memory_store.put_value(oid, data)
         else:
@@ -246,7 +272,8 @@ class CoreWorker:
         # 1) owned shm objects (ray.put of large values records a location
         #    without touching the memory store)
         if oid in self.locations:
-            return await self._read_location(oid, self.locations[oid])
+            data = await self._read_location(oid, self.locations[oid])
+            return await self._maybe_reconstruct(ref, data, deadline)
         # 2) local shm store (results produced on this node)
         buf = self.shm.get(oid)
         if buf is not None:
@@ -257,10 +284,11 @@ class CoreWorker:
             if kind == "err":
                 return payload
             if payload is None:  # marker: result went to shm
-                loc = self.locations.get(oid)
-                return await self._read_location(oid, loc)
+                data = await self._read_location(oid, self.locations.get(oid))
+                return await self._maybe_reconstruct(ref, data, deadline)
             return payload
-        # 3) ask the owner
+        # 4) ask the owner (borrower path)
+        lost_notifies = 0
         while True:
             info = await self._ask_owner(ref)
             if info is None:
@@ -270,11 +298,39 @@ class CoreWorker:
             if "inline" in info:
                 return info["inline"]
             if "location" in info:
-                return await self._read_location(oid, info["location"])
+                data = await self._read_location(oid, info["location"])
+                if not isinstance(data, exc.ObjectLostError):
+                    return data
+                # location is stale (node died): tell the owner so it can
+                # lineage-reconstruct, then keep polling for the new copy
+                lost_notifies += 1
+                if lost_notifies > 3:
+                    return data
+                conn = await self._conn_to(ref.owner_addr, kind="worker")
+                if conn is not None:
+                    try:
+                        await conn.call(
+                            "object_lost", oid_hex=oid.hex(), timeout=30
+                        )
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
+                await asyncio.sleep(0.2)
             # pending — poll with backoff
             if deadline is not None and time.monotonic() > deadline:
                 raise exc.GetTimeoutError(f"get timed out on {oid.hex()[:16]}")
             await asyncio.sleep(0.01)
+
+    async def _maybe_reconstruct(self, ref: ObjectRef, data, deadline):
+        """Owner-side: a location read failed → resubmit the creating task
+        via lineage and re-fetch (object_recovery_manager.h:41)."""
+        if not isinstance(data, exc.ObjectLostError):
+            return data
+        if not await self._reconstruct(ref):
+            return data
+        remaining = (
+            None if deadline is None else max(0.0, deadline - time.monotonic())
+        )
+        return await self._fetch_serialized(ref, remaining)
 
     async def _ask_owner(self, ref: ObjectRef):
         conn = await self._conn_to(ref.owner_addr, kind="worker")
@@ -292,14 +348,19 @@ class CoreWorker:
             buf = self.shm.get(oid)
             if buf is not None:
                 return buf.buffer
-        # remote node: ask local raylet to pull, then read locally
+        # remote node: ask local raylet to pull, then read locally. A failing
+        # pull (source node dead) must fall through to the direct fetch and
+        # ultimately ObjectLostError → lineage reconstruction, not raise.
         if self.raylet is not None:
-            ok = await self.raylet.call(
-                "pull_object",
-                oid_hex=oid.hex(),
-                source_addr=loc["raylet_addr"],
-                timeout=120,
-            )
+            try:
+                ok = await self.raylet.call(
+                    "pull_object",
+                    oid_hex=oid.hex(),
+                    source_addr=loc["raylet_addr"],
+                    timeout=120,
+                )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                ok = False
             if ok:
                 buf = self.shm.get(oid)
                 if buf is not None:
@@ -322,12 +383,22 @@ class CoreWorker:
         conn = cache.get(addr)
         if conn is not None and not conn.closed:
             return conn
-        try:
-            conn = await rpc.connect(addr, handler=self, retries=3, name=f"->{addr}")
-        except rpc.ConnectionLost:
-            return None
-        cache[addr] = conn
-        return conn
+        # serialize creation per address: concurrent pipelined sends must all
+        # ride ONE connection — two connections to the same actor worker lose
+        # the frame-order guarantee actor-call ordering depends on
+        lock = self._conn_locks.setdefault((kind, addr), asyncio.Lock())
+        async with lock:
+            conn = cache.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            try:
+                conn = await rpc.connect(
+                    addr, handler=self, retries=3, name=f"->{addr}"
+                )
+            except rpc.ConnectionLost:
+                return None
+            cache[addr] = conn
+            return conn
 
     def wait(
         self, refs, num_returns: int, timeout: Optional[float], fetch_local: bool
@@ -411,6 +482,10 @@ class CoreWorker:
         )
         self.submitted_specs[task_id] = spec
         refs = spec.return_refs()
+        for r in refs:
+            self._own(r.id, task_id)
+        self._pin_task_args(task_id, enc_args, enc_kwargs)
+        self._record_task_event(spec, "SUBMITTED")
         self.io.spawn(self._submit_and_track(spec, refs))
         return refs
 
@@ -442,8 +517,40 @@ class CoreWorker:
                 )
                 return
 
+    async def _ensure_raylet(self):
+        """Driver-side: if the adopted raylet died (remote cluster, node
+        loss), re-adopt a live one from the GCS node table — otherwise every
+        subsequent submission (including lineage resubmissions) fails on the
+        dead connection. Workers never re-adopt: they die with their raylet
+        (worker_main watchdog)."""
+        if (self.raylet is not None and not self.raylet.closed) \
+                or self.mode != "driver" or self.gcs is None:
+            return self.raylet
+        nodes = await self.gcs.call("get_nodes", timeout=30) or []
+        node = next(
+            (n for n in nodes if n["Alive"] and n["NodeID"] == self.node_id),
+            None,
+        ) or next((n for n in nodes if n["Alive"]), None)
+        if node is None:
+            return self.raylet
+        conn = await self._conn_to(node["NodeManagerAddress"], kind="raylet")
+        if conn is None:
+            return self.raylet
+        self.raylet = conn
+        self.raylet_address = node["NodeManagerAddress"]
+        self.node_id = node["NodeID"]
+        if node["Session"] != self.session:
+            from ray_tpu.core.object_store.shm_store import ShmClient
+
+            self.session = node["Session"]
+            self.shm = ShmClient(self.session)
+        logger.warning(
+            "re-adopted raylet %s (node %s)", self.raylet_address, self.node_id
+        )
+        return self.raylet
+
     async def _submit_once(self, spec: ts.TaskSpec) -> dict:
-        raylet = self.raylet
+        raylet = await self._ensure_raylet()
         raylet_addr = self.raylet_address
         if spec.placement_group_id is not None:
             # route straight to a raylet holding the target bundle
@@ -456,13 +563,20 @@ class CoreWorker:
                     raise exc.RayTpuError(f"placement-group node {addr} gone")
                 raylet, raylet_addr = conn, addr
         for _hop in range(8):  # spillback chain bound
-            reply = await raylet.call(
-                "request_lease",
-                resources=spec.resources,
-                pg_id=spec.placement_group_id,
-                bundle_index=spec.placement_group_bundle_index,
-                timeout=None,
-            )
+            try:
+                reply = await raylet.call(
+                    "request_lease",
+                    resources=spec.resources,
+                    pg_id=spec.placement_group_id,
+                    bundle_index=spec.placement_group_bundle_index,
+                    timeout=None,
+                )
+            except rpc.ConnectionLost as e:
+                # raylet died mid-lease: retryable system failure (the retry
+                # re-enters _submit_once, which re-adopts a live raylet)
+                raise exc.WorkerCrashedError(
+                    f"raylet {raylet_addr} lost during lease: {e}"
+                ) from e
             if "granted" in reply:
                 return await self._push_to_worker(
                     raylet, raylet_addr, reply, spec
@@ -525,14 +639,281 @@ class CoreWorker:
             elif kind == "error":
                 err = cloudpickle.loads(payload)
                 self.memory_store.put_error(ref.id, err)
+        # borrows the executing worker announced in its reply register BEFORE
+        # the arg pins drop, so a stored ref can't be freed in the gap
+        for oid_hex, addr in result.get("borrows", []):
+            self.handle_add_borrow(None, oid_hex, addr)
+        # refs nested in the result: the worker pre-registered us as borrower
+        # with each owner. Pin each to this task's return oids — we release
+        # when the outer value is freed (or when a deserialized inner ref's
+        # last local copy dies after that), see _maybe_free.
+        granted = result.get("granted") or []
+        if granted:
+            outer_keys = [r.id.binary() for r in refs]
+            for oid_hex, owner_addr in granted:
+                key = ObjectID.from_hex(oid_hex).binary()
+                if self._is_owner(owner_addr):
+                    continue
+                self._reported_borrows.add(key)
+                self._granted_owner[key] = owner_addr
+                self._granting_outers.setdefault(key, set()).update(outer_keys)
+                for ok in outer_keys:
+                    self._granted_by_outer.setdefault(ok, set()).add(key)
+        self._unpin_task_args(spec.task_id)
+        failed = any(kind == "error" for kind, _ in entries)
+        self._record_task_event(spec, "FAILED" if failed else "FINISHED")
 
     def _store_task_error(self, refs, error: BaseException):
         for ref in refs:
             self.memory_store.put_error(ref.id, error)
+        if refs:
+            self._unpin_task_args(refs[0].task_id)
+
+    # ---------------------------------------------------------- task events
+    def _record_task_event(self, spec, state: str) -> None:
+        with self._task_events_lock:
+            self._task_events.append({
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                "time": time.time(),
+                "worker": self.address,
+            })
+
+    async def _flush_task_events_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            with self._task_events_lock:
+                events, self._task_events = self._task_events, []
+            if events and self.gcs and not self.gcs.closed:
+                try:
+                    await self.gcs.call("report_task_events", events=events)
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
+
+    # ----------------------------------------------- distributed refcounting
+    # Owner-based (reference_count.h:61): the submitting/putting process owns
+    # each object and frees it cluster-wide when (a) no live ObjectRef in the
+    # owner process, (b) no pending task holds it as an argument, and (c) no
+    # borrower process has announced live refs. Borrowers (processes that
+    # deserialized the ref) announce via the task reply ("borrows") or an
+    # add_borrow RPC and release on their local zero-crossing.
+
+    def _is_owner(self, owner_addr: Optional[str]) -> bool:
+        return owner_addr is None or owner_addr == self.address
+
+    def _own(self, oid: ObjectID, task_id: Optional[TaskID] = None) -> None:
+        self._owned.setdefault(oid.binary(), {"pending": 0, "borrowers": set()})
+        if task_id is not None:
+            self._return_oid_task[oid.binary()] = task_id
+
+    def _pin_task_args(self, task_id: TaskID, enc_args, enc_kwargs) -> None:
+        pins: List[bytes] = []
+        for t, v in list(enc_args) + list(enc_kwargs.values()):
+            if t == ts.ARG_REF and self._is_owner(v.owner_addr):
+                entry = self._owned.get(v.id.binary())
+                if entry is not None:
+                    entry["pending"] += 1
+                    pins.append(v.id.binary())
+        if pins:
+            self._task_arg_pins[task_id] = pins
+
+    def _unpin_task_args(self, task_id: Optional[TaskID]) -> None:
+        if task_id is None:
+            return
+        for key in self._task_arg_pins.pop(task_id, []):
+            entry = self._owned.get(key)
+            if entry is not None:
+                entry["pending"] -= 1
+                self._maybe_free(key)
+
+    def _on_local_refs_zero(self, oid, owner_addr, task_id) -> None:
+        """GC callback (arbitrary thread): last local ObjectRef died."""
+        try:
+            if self._is_owner(owner_addr):
+                self.io.loop.call_soon_threadsafe(
+                    self._maybe_free, oid.binary()
+                )
+            elif oid.binary() in self._reported_borrows:
+                if self._granting_outers.get(oid.binary()):
+                    # an outer result value still pins this borrow: a later
+                    # get() could re-materialize the ref, so release only
+                    # when the outer itself is freed (_maybe_free)
+                    return
+                self._reported_borrows.discard(oid.binary())
+                self._granted_owner.pop(oid.binary(), None)
+                self.io.spawn(
+                    self._notify_owner(
+                        owner_addr, "release_borrow", oid_hex=oid.hex(),
+                        addr=self.address,
+                    )
+                )
+        except Exception:  # noqa: BLE001 - shutdown
+            pass
+
+    async def _notify_owner(self, owner_addr, method, **payload):
+        conn = await self._conn_to(owner_addr, kind="worker")
+        if conn is not None:
+            try:
+                await conn.call(method, timeout=30, **payload)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+
+    def _maybe_free(self, key: bytes) -> None:
+        from ray_tpu.core import refs as refs_mod
+
+        entry = self._owned.get(key)
+        if entry is None:
+            return
+        if (refs_mod.local_ref_count(key) > 0 or entry["pending"] > 0
+                or entry["borrowers"]):
+            return
+        self._owned.pop(key, None)
+        self._early_borrow_releases.pop(key, None)
+        oid = ObjectID(key)
+        self.memory_store.delete(oid)
+        loc = self.locations.pop(oid, None)
+        addrs = {a for a in (
+            loc.get("raylet_addr") if loc else None, self.raylet_address
+        ) if a}
+        if addrs:
+            self.io.spawn(self._free_on_raylets(oid, addrs))
+        # borrows granted through this (outer) result value: the outer no
+        # longer pins them — release any with no other pin and no live ref
+        for inner in self._granted_by_outer.pop(key, ()):
+            outs = self._granting_outers.get(inner)
+            if outs is not None:
+                outs.discard(key)
+                if outs:
+                    continue
+                self._granting_outers.pop(inner, None)
+            if (refs_mod.local_ref_count(inner) == 0
+                    and inner in self._reported_borrows):
+                self._reported_borrows.discard(inner)
+                owner = self._granted_owner.pop(inner, None)
+                if owner:
+                    self.io.spawn(
+                        self._notify_owner(
+                            owner, "release_borrow",
+                            oid_hex=ObjectID(inner).hex(), addr=self.address,
+                        )
+                    )
+        # lineage cleanup: once every return of a task is freed, its spec is
+        # no longer needed for reconstruction
+        tid = self._return_oid_task.pop(key, None)
+        if tid is not None and not any(
+            t == tid for t in self._return_oid_task.values()
+        ):
+            self.submitted_specs.pop(tid, None)
+            self._task_arg_pins.pop(tid, None)
+
+    async def _free_on_raylets(self, oid: ObjectID, addrs) -> None:
+        for addr in addrs:
+            conn = await self._conn_to(addr, kind="raylet")
+            if conn is not None:
+                try:
+                    await conn.call("free_objects", oids_hex=[oid.hex()], timeout=30)
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
+
+    # owner-side borrow bookkeeping.
+    # A borrower's release (its own connection) can arrive BEFORE the add
+    # that rides a task reply on a different connection — the borrowing
+    # worker's ref dies on the executor thread the instant the task frame
+    # exits, racing the reply write. An early release is remembered and
+    # cancels the matching add when it lands, else the borrower sticks
+    # forever and the object leaks.
+    def handle_add_borrow(self, conn, oid_hex, addr):
+        key = ObjectID.from_hex(oid_hex).binary()
+        early = self._early_borrow_releases.get(key)
+        if early is not None and addr in early:
+            early.discard(addr)
+            if not early:
+                self._early_borrow_releases.pop(key, None)
+            return True  # add + earlier release cancel out
+        entry = self._owned.get(key)
+        if entry is not None:
+            entry["borrowers"].add(addr)
+        return True
+
+    def handle_release_borrow(self, conn, oid_hex, addr):
+        key = ObjectID.from_hex(oid_hex).binary()
+        entry = self._owned.get(key)
+        if entry is not None and addr in entry["borrowers"]:
+            entry["borrowers"].discard(addr)
+            self._maybe_free(key)
+        elif entry is not None:
+            self._early_borrow_releases.setdefault(key, set()).add(addr)
+        return True
+
+    def report_new_borrows(self) -> List[tuple]:
+        """Borrower side: oids deserialized here, still alive, not yet
+        announced. Returns [(oid_hex, owner_addr)] and marks them reported."""
+        from ray_tpu.core import refs as refs_mod
+
+        out = []
+        for key, owner_addr in refs_mod.live_refs().items():
+            if owner_addr is None or self._is_owner(owner_addr):
+                continue
+            if key in self._reported_borrows:
+                continue
+            self._reported_borrows.add(key)
+            out.append((ObjectID(key).hex(), owner_addr))
+        return out
+
+    # ------------------------------------------------ lineage reconstruction
+    async def _reconstruct(self, ref: ObjectRef) -> bool:
+        """Resubmit the task that produced a lost owned object (parity:
+        TaskManager resubmission task_manager.h:164 + ObjectRecoveryManager).
+        Returns True if a resubmission completed."""
+        spec = self.submitted_specs.get(ref.task_id) if ref.task_id else None
+        if spec is None or spec.actor_id is not None:
+            return False
+        key = spec.task_id.binary()
+        ev = self._reconstructing.get(key)
+        if ev is not None:
+            await ev.wait()
+            return True
+        # bounded: each lineage task resubmits at most max(1, max_retries)
+        # times total, mirroring the reference's resubmission cap — without
+        # this a repeatedly-lost object loops owner-side reconstruction
+        # forever on a no-timeout get
+        attempts = self._reconstruct_attempts.get(key, 0)
+        if attempts >= max(1, spec.max_retries):
+            return False
+        self._reconstruct_attempts[key] = attempts + 1
+        ev = asyncio.Event()
+        self._reconstructing[key] = ev
+        try:
+            logger.warning(
+                "reconstructing lost object(s) of task %s via lineage",
+                spec.name,
+            )
+            refs = spec.return_refs()
+            for r in refs:
+                self.memory_store.delete(r.id)
+                self.locations.pop(r.id, None)
+            await self._submit_and_track(spec, refs)
+            return True
+        finally:
+            ev.set()
+            self._reconstructing.pop(key, None)
+
+    def handle_object_lost(self, conn, oid_hex, task_id_bin=None):
+        """A borrower failed to read one of our objects: reconstruct."""
+        oid = ObjectID.from_hex(oid_hex)
+        tid = self._return_oid_task.get(oid.binary())
+        if tid is None:
+            return False
+        ref = ObjectRef(oid, owner_addr=self.address, task_id=tid)
+        self.io.spawn(self._reconstruct(ref))
+        return True
 
     # ---------------------------------------------------------- actor calls
     def create_actor(self, cls, args, kwargs, options: RemoteOptions) -> ActorID:
         actor_id = ActorID.from_random()
+        pg_id, pg_index = _pg_fields(options)
         blob = _pickle_callable(cls)
         fn_id = ts.function_id(blob)
         if fn_id not in self._registered_fns:
@@ -563,6 +944,8 @@ class CoreWorker:
                 max_restarts=options.max_restarts,
                 resources=spec.resources,
                 get_if_exists=options.get_if_exists,
+                pg_id=pg_id,
+                bundle_index=-1 if pg_index is None else pg_index,
             )
         )
         return ActorID(reply["actor_id"])
@@ -585,32 +968,150 @@ class CoreWorker:
             max_retries=options.max_task_retries,
         )
         refs = spec.return_refs()
-        # Per-actor FIFO: one consumer pushes calls strictly in submission
-        # order, awaiting each response before the next send. This keeps
-        # ordering correct across actor RESTARTS with no sequence-number
-        # protocol (the reference pipelines with seq_nos —
-        # direct_actor_task_submitter.h; pipelining here is a future
-        # optimization, it changes throughput not semantics).
+        for r in refs:
+            self._own(r.id)  # actor results owned, but not lineage-rebuildable
+        self._pin_task_args(task_id, enc_args, enc_kwargs)
+        # Pipelined per-actor submission (parity:
+        # direct_actor_task_submitter.h seq-no pipelining): up to
+        # actor_max_inflight_calls ride the wire concurrently. Ordering on
+        # the happy path is free — one TCP connection delivers frames in
+        # send order and the receiver's single-thread executor runs them
+        # FIFO (worker_main.handle_push_actor_task). On a connection loss
+        # the window closes, in-flight sends settle, and failed calls are
+        # re-driven one-by-one in sequence order against the restarted
+        # actor before the window reopens (restart-safe ordering).
         with self._lock:
-            q = self._actor_queues.get(actor_id.binary())
-            if q is None:
-                q = asyncio.Queue()
-                self._actor_queues[actor_id.binary()] = q
-                self.io.spawn(self._actor_queue_consumer(q))
-        self.io.loop.call_soon_threadsafe(q.put_nowait, (spec, refs))
+            st = self._actor_queues.get(actor_id.binary())
+            if st is None:
+                st = _ActorSubmitState(_config.actor_max_inflight_calls)
+                self._actor_queues[actor_id.binary()] = st
+                self.io.spawn(
+                    self._actor_queue_consumer(actor_id.binary(), st)
+                )
+        self.io.loop.call_soon_threadsafe(st.queue.put_nowait, (spec, refs))
         return refs
 
-    async def _actor_queue_consumer(self, q: asyncio.Queue):
+    async def _actor_queue_consumer(self, actor_bin: bytes, st: "_ActorSubmitState"):
+        """Single sender per actor: address resolution AND the frame write
+        happen here, strictly in seq order — only response awaits run
+        concurrently. Concurrent per-call resolution raced (GCS wait_alive
+        responses complete in arbitrary order), letting seq N+1's frame hit
+        the wire first."""
         while True:
-            spec, refs = await q.get()
+            spec, refs = await st.queue.get()
+            seq = st.next_seq
+            st.next_seq += 1
+            await st.gate.wait()        # closed while a recovery is replaying
+            await st.sem.acquire()
+            st.inflight[seq] = (spec, refs)
             try:
-                await self._submit_actor_task_async(spec, refs)
-            except Exception as e:  # noqa: BLE001 - consumer must not die
+                addr = await self._resolve_actor(actor_bin)
+                if addr is None:
+                    self._store_task_error(
+                        refs, exc.ActorDiedError(spec.actor_id, "actor is dead")
+                    )
+                    st.inflight.pop(seq, None)
+                    st.sem.release()
+                    continue
+                conn = await self._conn_to(addr, kind="worker")
+                if conn is None or not st.gate.is_set():
+                    # Never sent: either the cached address is stale (actor
+                    # restarting — _conn_to can't reach it) or a loss fired
+                    # while we resolved. Hand to the ordered recovery replay,
+                    # which re-resolves on its own budget — this must NOT
+                    # burn max_task_retries / fail at-most-once calls, since
+                    # the call was never delivered.
+                    st.inflight.pop(seq, None)
+                    st.sem.release()
+                    st.failed[seq] = (spec, refs)
+                    self._actor_addr_cache.pop(actor_bin, None)
+                    if not st.recovering:
+                        st.recovering = True
+                        st.gate.clear()
+                        asyncio.ensure_future(self._recover_actor_calls(st))
+                    continue
+                fut = await conn.call_start(
+                    "push_actor_task", spec_blob=cloudpickle.dumps(spec)
+                )
+            except rpc.ConnectionLost:
+                st.inflight.pop(seq, None)
+                st.sem.release()
+                self._on_pipelined_loss(actor_bin, st, seq, spec, refs)
+                continue
+            except Exception as e:  # noqa: BLE001 - must not lose the refs
                 self._store_task_error(
                     refs, exc.RayTpuError(f"actor submission failed: {e!r}")
                 )
+                st.inflight.pop(seq, None)
+                st.sem.release()
+                continue
+            task = asyncio.create_task(
+                self._pipelined_await(actor_bin, st, seq, spec, refs, fut)
+            )
+            st.tasks.add(task)
+            task.add_done_callback(st.tasks.discard)
+
+    async def _pipelined_await(self, actor_bin, st, seq, spec, refs, fut):
+        try:
+            result = await fut
+            self._store_task_result(spec, refs, result)
+        except rpc.ConnectionLost:
+            self._on_pipelined_loss(actor_bin, st, seq, spec, refs)
+        except Exception as e:  # noqa: BLE001 - must not lose the refs
+            self._store_task_error(
+                refs, exc.RayTpuError(f"actor submission failed: {e!r}")
+            )
+        finally:
+            st.inflight.pop(seq, None)
+            st.sem.release()
+
+    def _on_pipelined_loss(self, actor_bin, st, seq, spec, refs):
+        """Connection loss on a pipelined call: close the window NOW (before
+        any further send can resolve the restarted actor's address) and queue
+        the call for ordered replay. At-most-once calls (max_retries<=0) may
+        have executed before the connection died, so they fail instead."""
+        self._actor_addr_cache.pop(actor_bin, None)
+        if spec.max_retries <= 0:
+            self._store_task_error(
+                refs,
+                exc.ActorDiedError(
+                    spec.actor_id, "actor worker died during call"
+                ),
+            )
+        else:
+            st.failed[seq] = (spec, refs)
+        if not st.recovering:
+            st.recovering = True
+            st.gate.clear()
+            asyncio.ensure_future(self._recover_actor_calls(st))
+
+    async def _recover_actor_calls(self, st: "_ActorSubmitState"):
+        """Replay failed calls in sequence order after a connection loss.
+        Loops until no in-flight call remains AND no failed entry remains:
+        in-flight calls that fail mid-recovery join st.failed and are picked
+        up by the next pass instead of being stranded forever."""
+        try:
+            while True:
+                while st.inflight:       # let concurrent sends settle
+                    await asyncio.sleep(0.01)
+                if not st.failed:
+                    break
+                while st.failed:
+                    seq = min(st.failed)
+                    spec, refs = st.failed.pop(seq)
+                    try:
+                        await self._submit_actor_task_async(spec, refs)
+                    except Exception as e:  # noqa: BLE001
+                        self._store_task_error(
+                            refs,
+                            exc.RayTpuError(f"actor submission failed: {e!r}"),
+                        )
+        finally:
+            st.recovering = False
+            st.gate.set()
 
     async def _submit_actor_task_async(self, spec: ts.TaskSpec, refs):
+        # sequential (await-each-response) path, used for recovery replay
         # in-flight failures burn max_task_retries (reference semantics);
         # stale-address resolution failures retry on their own budget —
         # a restarting actor must not fail calls that were never delivered
@@ -718,6 +1219,22 @@ def _pickle_callable(fn) -> bytes:
             cloudpickle.unregister_pickle_by_value(mod)
     except Exception:  # noqa: BLE001 - fall back to by-reference
         return cloudpickle.dumps(fn)
+
+
+class _ActorSubmitState:
+    """Per-actor pipelined submission window (client side of the seq-no
+    protocol; see submit_actor_task)."""
+
+    def __init__(self, window: int):
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.sem = asyncio.Semaphore(max(1, window))
+        self.next_seq = 0
+        self.inflight: Dict[int, tuple] = {}
+        self.failed: Dict[int, tuple] = {}
+        self.recovering = False
+        self.gate = asyncio.Event()
+        self.gate.set()
+        self.tasks: set = set()
 
 
 def _pg_fields(options: RemoteOptions):

@@ -79,6 +79,9 @@ class ActorInfo:
     resources: Dict[str, float] = field(default_factory=dict)
     death_reason: str = ""
     num_restarts: int = 0
+    pg_id: Optional[bytes] = None
+    bundle_index: int = -1
+    sched_attempts: int = 0         # rotates unspecified-bundle placement
 
     def public(self) -> dict:
         return {
@@ -118,6 +121,12 @@ class GcsServer:
         self._conn_owned_actors: Dict[rpc.Connection, Set[bytes]] = {}
         self._conn_owned_pgs: Dict[rpc.Connection, Set[bytes]] = {}
         self._bg: List[asyncio.Task] = []
+        # observability: bounded task-event log (GcsTaskManager analog,
+        # gcs_task_manager.h:61) + monotonically-counted cluster metrics
+        from collections import deque
+
+        self.task_events: "deque" = deque(maxlen=10_000)
+        self.metrics: Dict[str, int] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -252,6 +261,8 @@ class GcsServer:
         max_restarts=0,
         resources=None,
         get_if_exists=False,
+        pg_id=None,
+        bundle_index=-1,
     ):
         if name:
             key = (namespace, name)
@@ -271,6 +282,8 @@ class GcsServer:
             max_restarts=max_restarts,
             restarts_left=max_restarts,
             resources=resources or {},
+            pg_id=pg_id,
+            bundle_index=bundle_index,
         )
         self.actors[actor_id] = info
         if not detached:
@@ -280,14 +293,44 @@ class GcsServer:
 
     async def _schedule_actor(self, info: ActorInfo):
         demand = ResourceSet(info.resources)
-        views = [n.view() for n in self.nodes.values()]
-        node_id = hybrid_policy(
-            demand,
-            views,
-            spread_threshold=_config.scheduler_spread_threshold,
-            top_k_fraction=_config.scheduler_top_k_fraction,
-        )
-        if node_id is None:
+        if info.pg_id is not None:
+            # PG actor: its node is dictated by the bundle placement, and its
+            # resources come from the bundle reservation — never deduct from
+            # the node view (the bundle already did; double-booking starved
+            # plain tasks, round-3 fix).
+            pg = self.placement_groups.get(info.pg_id)
+            if pg is None:
+                # its PG was removed (actors reference PGs that exist at
+                # creation): without this the actor reschedules every 0.5s
+                # forever while callers burn wait_alive timeouts
+                await self._mark_actor_dead(
+                    info, "placement group removed before actor scheduled"
+                )
+                return
+            if not pg.placement:
+                asyncio.get_running_loop().call_later(
+                    0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
+                )
+                return
+            if info.bundle_index >= 0:
+                idx = info.bundle_index
+            else:
+                # unspecified bundle: rotate across bundle nodes on each
+                # attempt — pinning to bundle 0's node starved actors when
+                # that node's bundles were full but another node's were free
+                # (the raylet can only draw from its OWN bundles)
+                idx = info.sched_attempts % len(pg.placement)
+            info.sched_attempts += 1
+            node_id = pg.placement[idx]
+        else:
+            views = [n.view() for n in self.nodes.values()]
+            node_id = hybrid_policy(
+                demand,
+                views,
+                spread_threshold=_config.scheduler_spread_threshold,
+                top_k_fraction=_config.scheduler_top_k_fraction,
+            )
+        if node_id is None or node_id not in self.nodes:
             # queue until resources free up: retry on next resource report
             asyncio.get_running_loop().call_later(
                 0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
@@ -295,20 +338,24 @@ class GcsServer:
             return
         node = self.nodes[node_id]
         info.node_id = node_id
-        # optimistic deduction so back-to-back placements don't double-book the
-        # node before its next resource report
-        node.available = node.available.subtract(demand)
+        if info.pg_id is None:
+            # optimistic deduction so back-to-back placements don't
+            # double-book the node before its next resource report
+            node.available = node.available.subtract(demand)
         try:
             await node.conn.call(
                 "create_actor_worker",
                 actor_id=info.actor_id,
                 spec_blob=info.spec_blob,
                 resources=info.resources,
+                pg_id=info.pg_id,
+                bundle_index=info.bundle_index,
                 timeout=_config.gcs_rpc_timeout_s,
             )
         except (rpc.RpcError, rpc.ConnectionLost):
             # stale view or raylet race — requeue, do NOT burn a restart
-            node.available = node.available.add(demand)
+            if info.pg_id is None:
+                node.available = node.available.add(demand)
             info.node_id = None
             asyncio.get_running_loop().call_later(
                 0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
@@ -371,6 +418,42 @@ class GcsServer:
         if actor_id is None:
             return None
         return self.actors[actor_id].public()
+
+    # ------------------------------------------------------- observability
+    def handle_report_task_events(self, conn, events: List[dict]):
+        """Workers/drivers flush buffered task state transitions here
+        (task_event_buffer.h:193 → GcsTaskManager)."""
+        self.task_events.extend(events)
+        for e in events:
+            key = f"tasks_{e.get('state', 'UNKNOWN').lower()}"
+            self.metrics[key] = self.metrics.get(key, 0) + 1
+        return True
+
+    def handle_list_tasks(self, conn, limit=1000):
+        return list(self.task_events)[-limit:]
+
+    def handle_list_placement_groups(self, conn):
+        return [
+            {
+                "pg_id": info.pg_id,
+                "state": info.state,
+                "bundles": info.bundles,
+                "strategy": info.strategy,
+                "placement": info.placement,
+            }
+            for info in self.placement_groups.values()
+        ]
+
+    def handle_get_metrics(self, conn):
+        m = dict(self.metrics)
+        m["num_nodes"] = len(self.nodes)
+        m["num_alive_nodes"] = sum(1 for n in self.nodes.values() if n.alive)
+        m["num_actors"] = len(self.actors)
+        m["num_alive_actors"] = sum(
+            1 for a in self.actors.values() if a.state == ALIVE
+        )
+        m["num_placement_groups"] = len(self.placement_groups)
+        return m
 
     def handle_list_actors(self, conn):
         return [a.public() for a in self.actors.values()]

@@ -340,6 +340,22 @@ class LocalBackend(Backend):
             }
         ]
 
+    def state_call(self, method, **kwargs):
+        """Local-mode backing for util.state (no GCS process)."""
+        if method == "get_nodes":
+            return self.nodes()
+        if method == "list_actors":
+            return [
+                {"actor_id": aid.binary(), "state": "ALIVE"}
+                for aid, a in self._actors.items()
+            ]
+        if method in ("list_tasks", "list_placement_groups", "object_stats"):
+            return []
+        if method == "get_metrics":
+            return {"num_nodes": 1, "num_alive_nodes": 1,
+                    "num_actors": len(self._actors)}
+        raise ValueError(f"unknown state method {method!r}")
+
     def shutdown(self):
         for a in list(self._actors.values()):
             a.stop()

@@ -88,7 +88,8 @@ class Raylet:
         self._bg: List[asyncio.Task] = []
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._actor_specs: Dict[bytes, bytes] = {}
-        self._actor_resources: Dict[bytes, ResourceSet] = {}
+        # actor_id → (release token from _acquire_for-style accounting, demand)
+        self._actor_resources: Dict[bytes, Tuple[object, ResourceSet]] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -193,23 +194,27 @@ class Raylet:
         return await lease.future
 
     def _acquire_for(self, lease: LeaseRequest) -> Optional[object]:
-        """Try to take resources for a lease. Returns an opaque release token
-        or None. PG leases draw from the bundle's reservation; plain leases
-        from node availability."""
-        if lease.pg_id is not None:
+        return self._acquire(lease.demand, lease.pg_id, lease.bundle_index)
+
+    def _acquire(self, demand: ResourceSet, pg_id=None,
+                 bundle_index: int = -1) -> Optional[object]:
+        """Try to take resources for a lease or actor. Returns an opaque
+        release token or None. PG consumers draw from the bundle's
+        reservation; plain ones from node availability."""
+        if pg_id is not None:
             keys = (
-                [(lease.pg_id, lease.bundle_index)]
-                if lease.bundle_index >= 0
-                else sorted(k for k in self.bundle_free if k[0] == lease.pg_id)
+                [(pg_id, bundle_index)]
+                if bundle_index >= 0
+                else sorted(k for k in self.bundle_free if k[0] == pg_id)
             )
             for key in keys:
                 free = self.bundle_free.get(key)
-                if free is not None and free.fits(lease.demand):
-                    self.bundle_free[key] = free.subtract(lease.demand)
+                if free is not None and free.fits(demand):
+                    self.bundle_free[key] = free.subtract(demand)
                     return ("bundle", key)
             return None
-        if self.available.fits(lease.demand):
-            self.available = self.available.subtract(lease.demand)
+        if self.available.fits(demand):
+            self.available = self.available.subtract(demand)
             return ("node", None)
         return None
 
@@ -317,6 +322,11 @@ class Raylet:
         if worker.state == LEASED:
             worker.state = IDLE
             worker.lease_id = None
+        # re-dispatch immediately: queued leases must not wait for the next
+        # 50 ms poll tick (that cap showed up as ~80 task/s in the
+        # microbenchmark — one dispatch round per tick)
+        if self.pending_leases:
+            asyncio.ensure_future(self._dispatch())
         return True
 
     # ------------------------------------------------------------- workers
@@ -341,9 +351,10 @@ class Raylet:
         if handle.lease_id:
             self.handle_return_lease(None, handle.lease_id)
         if handle.actor_id is not None:
-            demand = self._actor_resources.pop(handle.actor_id, None)
-            if demand is not None:
-                self.available = self.available.add(demand)
+            entry = self._actor_resources.pop(handle.actor_id, None)
+            if entry is not None:
+                token, demand = entry
+                self._release_token(token, demand)
             try:
                 await self.gcs.call(
                     "actor_failed",
@@ -354,14 +365,23 @@ class Raylet:
                 pass
 
     # -------------------------------------------------------------- actors
-    async def handle_create_actor_worker(self, conn, actor_id, spec_blob, resources):
+    async def handle_create_actor_worker(self, conn, actor_id, spec_blob,
+                                         resources, pg_id=None, bundle_index=-1):
+        """Spawn a dedicated worker for an actor. PG actors draw their
+        resources from the bundle's reservation (same as PG task leases in
+        _acquire_for) — NOT from node availability, which the bundle already
+        debited; double-booking starved plain tasks (round-3 fix)."""
         demand = ResourceSet(resources)
-        if not self.available.fits(demand):
-            # GCS picked us from a stale view; let it retry
-            raise RuntimeError("resources no longer available")
-        self.available = self.available.subtract(demand)
+        token = self._acquire(demand, pg_id, bundle_index)
+        if token is None:
+            # GCS picked us from a stale view (or the wrong bundle node);
+            # let it retry elsewhere
+            raise RuntimeError(
+                "placement-group bundle cannot fit actor" if pg_id is not None
+                else "resources no longer available"
+            )
         self._actor_specs[actor_id] = spec_blob
-        self._actor_resources[actor_id] = demand
+        self._actor_resources[actor_id] = (token, demand)
         handle = self.pool.start_worker(actor_id=actor_id)
         handle.state = ACTOR
         return True
@@ -373,9 +393,10 @@ class Raylet:
             # kill_worker marks the handle DEAD, so poll_deaths never routes
             # this through _on_worker_death — release the actor's resources
             # here or the node permanently leaks them.
-            demand = self._actor_resources.pop(actor_id, None)
-            if demand is not None:
-                self.available = self.available.add(demand)
+            entry = self._actor_resources.pop(actor_id, None)
+            if entry is not None:
+                token, demand = entry
+                self._release_token(token, demand)
             if handle.lease_id:
                 self.handle_return_lease(None, handle.lease_id)
             return True
@@ -402,6 +423,9 @@ class Raylet:
     def handle_object_added(self, conn, oid_hex, nbytes):
         self.directory.add(ObjectID.from_hex(oid_hex), nbytes)
         return True
+
+    def handle_object_stats(self, conn):
+        return self.directory.stats()
 
     def handle_free_objects(self, conn, oids_hex):
         for h in oids_hex:

@@ -9,13 +9,48 @@ process can resolve it without a central directory — the *owner* serves locati
 
 from __future__ import annotations
 
-from typing import Optional
+import threading
+from typing import Callable, Dict, Optional
 
 from ray_tpu.core.ids import ObjectID, TaskID
 
+# ---------------------------------------------------------------------------
+# Process-local reference registry (the Python half of distributed
+# refcounting, reference_count.h:61): counts live ObjectRef instances per
+# object id in THIS process. When the count drops to zero the registered
+# callback fires — the owner uses it to free the object cluster-wide once
+# no pending tasks/borrowers remain; borrowers use it to send a release to
+# the owner (core_worker._on_local_refs_zero).
+# ---------------------------------------------------------------------------
+_reg_lock = threading.Lock()
+_local_counts: Dict[bytes, int] = {}
+_owner_addrs: Dict[bytes, Optional[str]] = {}  # last-seen owner per live oid
+_on_zero: Optional[Callable[[ObjectID, Optional[str], Optional[TaskID]], None]] = None
+
+
+def set_on_zero_callback(
+    cb: Optional[Callable[[ObjectID, Optional[str], Optional[TaskID]], None]],
+) -> None:
+    global _on_zero
+    _on_zero = cb
+
+
+def local_ref_count(oid_bytes: bytes) -> int:
+    with _reg_lock:
+        return _local_counts.get(oid_bytes, 0)
+
+
+def live_refs() -> Dict[bytes, Optional[str]]:
+    """Snapshot of live oids → owner_addr in this process (borrow scan)."""
+    with _reg_lock:
+        return dict(_owner_addrs)
+
 
 class ObjectRef:
-    __slots__ = ("id", "owner_addr", "task_id", "_in_band_value", "_has_in_band")
+    __slots__ = (
+        "id", "owner_addr", "task_id", "_in_band_value", "_has_in_band",
+        "__weakref__",
+    )
 
     def __init__(
         self,
@@ -28,6 +63,26 @@ class ObjectRef:
         self.task_id = task_id  # creating task (for lineage reconstruction)
         self._in_band_value = None
         self._has_in_band = False
+        with _reg_lock:
+            key = object_id.binary()
+            _local_counts[key] = _local_counts.get(key, 0) + 1
+            if owner_addr is not None or key not in _owner_addrs:
+                _owner_addrs[key] = owner_addr
+
+    def __del__(self):
+        try:
+            key = self.id.binary()
+            with _reg_lock:
+                n = _local_counts.get(key, 0) - 1
+                if n <= 0:
+                    _local_counts.pop(key, None)
+                    _owner_addrs.pop(key, None)
+                else:
+                    _local_counts[key] = n
+            if n <= 0 and _on_zero is not None:
+                _on_zero(self.id, self.owner_addr, self.task_id)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
 
     def binary(self) -> bytes:
         return self.id.binary()

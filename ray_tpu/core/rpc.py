@@ -106,6 +106,30 @@ class Connection:
         finally:
             self._pending.pop(msg_id, None)
 
+    async def call_start(self, method: str, **payload) -> asyncio.Future:
+        """Write the request frame now, return the response future unawaited.
+
+        Pipelined senders (actor call windows) need the WRITE to happen at a
+        controlled point — frames on one TCP connection deliver in write
+        order — while responses are awaited concurrently. `call` = await
+        `call_start`.
+        """
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        msg_id = next(self._next_id)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        fut.add_done_callback(lambda f: self._pending.pop(msg_id, None))
+        try:
+            await self._send((REQUEST, msg_id, method, payload))
+        except ConnectionLost:
+            if fut.done():
+                fut.exception()  # consume, the raise below carries the error
+            else:
+                self._pending.pop(msg_id, None)
+            raise
+        return fut
+
     async def notify(self, method: str, **payload):
         """One-way message (no response expected)."""
         await self._send((REQUEST, 0, method, payload))

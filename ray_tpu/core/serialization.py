@@ -151,55 +151,74 @@ def _unregister_by_value(mod) -> None:
             _BY_VALUE_COUNTS[mod.__name__] = n - 1
 
 
+class _FrameworkPickler(cloudpickle.CloudPickler):
+    """Per-call pickler. Deliberately a MODULE-level class: a class defined
+    inside serialize() sits in a reference cycle (class → methods → closure
+    cells → contained_refs/buffers), so every serialized ObjectRef and
+    out-of-band buffer stayed alive until a gen-2 GC — which kept 'dead'
+    refs counted in the owner and deferred distributed frees indefinitely."""
+
+    def __init__(self, file, buffer_callback, contained_refs, registered_mods,
+                 registered_names):
+        # buffer_callback must be a plain function, NOT a bound method of
+        # self — the C pickler holding a bound method closes a cycle
+        # (pickler → method → pickler) that defers teardown to gen-2 GC,
+        # which is exactly the retention this class exists to avoid.
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self._contained_refs = contained_refs
+        self._registered_mods = registered_mods
+        self._registered_names = registered_names
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectRef):
+            self._contained_refs.append(obj)
+        # jax arrays nested inside containers
+        try:
+            import jax
+            import numpy as np
+
+            if isinstance(obj, jax.Array):
+                arr = np.asarray(obj)
+                return (_restore_ndarray,
+                        (pickle.PickleBuffer(arr), arr.dtype.str, arr.shape))
+        except ImportError:  # pragma: no cover
+            pass
+        # Functions/classes from user modules (test files, scripts) must
+        # travel by VALUE — the worker can't import their module. Register
+        # the module before delegating so cloudpickle's own reduce path
+        # sees it in the by-value registry.
+        mod = user_module_for_by_value(obj)
+        if mod is not None and mod.__name__ not in self._registered_names:
+            if _register_by_value(mod):
+                self._registered_mods.append(mod)
+                self._registered_names.add(mod.__name__)
+        # Delegate to cloudpickle so locally-defined / unimportable functions
+        # and classes are still pickled by value (the whole point of using
+        # CloudPickler); returning NotImplemented here would silently fall
+        # back to stdlib pickle for them.
+        return super().reducer_override(obj)
+
+
 def serialize(value: Any) -> SerializedObject:
     buffers: List[memoryview] = []
     contained_refs: List[ObjectRef] = []
     registered_mods: List[Any] = []
-    registered_names = set()
 
     value = _device_get_if_jax(value)
 
-    def buffer_callback(buf: pickle.PickleBuffer):
+    def _buffer_cb(buf: pickle.PickleBuffer):
         raw = buf.raw()
         if raw.nbytes < _OOB_THRESHOLD:
             return True  # keep in-band
         buffers.append(raw)
         return False
 
-    class _Pickler(cloudpickle.CloudPickler):
-        def persistent_id(self, obj):
-            return None
-
-        def reducer_override(self, obj):
-            if isinstance(obj, ObjectRef):
-                contained_refs.append(obj)
-            # jax arrays nested inside containers
-            try:
-                import jax
-                import numpy as np
-
-                if isinstance(obj, jax.Array):
-                    arr = np.asarray(obj)
-                    return (_restore_ndarray, (pickle.PickleBuffer(arr), arr.dtype.str, arr.shape))
-            except ImportError:  # pragma: no cover
-                pass
-            # Functions/classes from user modules (test files, scripts) must
-            # travel by VALUE — the worker can't import their module. Register
-            # the module before delegating so cloudpickle's own reduce path
-            # sees it in the by-value registry.
-            mod = user_module_for_by_value(obj)
-            if mod is not None and mod.__name__ not in registered_names:
-                if _register_by_value(mod):
-                    registered_mods.append(mod)
-                    registered_names.add(mod.__name__)
-            # Delegate to cloudpickle so locally-defined / unimportable functions
-            # and classes are still pickled by value (the whole point of using
-            # CloudPickler); returning NotImplemented here would silently fall
-            # back to stdlib pickle for them.
-            return super().reducer_override(obj)
-
     out = io.BytesIO()
-    p = _Pickler(out, protocol=5, buffer_callback=buffer_callback)
+    p = _FrameworkPickler(out, _buffer_cb, contained_refs, registered_mods,
+                          set())
     try:
         p.dump(value)
     finally:

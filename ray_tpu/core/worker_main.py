@@ -82,12 +82,43 @@ class WorkerAgent(CoreWorker):
                     attempts += 1
                     if spec.retry_exceptions and attempts <= spec.max_retries:
                         continue
-                    return self._error_result(spec, e)
-            return self._success_result(spec, result)
+                    return self._attach_borrows(spec, self._error_result(spec, e))
+            return self._attach_borrows(spec, self._success_result(spec, result))
         except exc.RayTpuError as e:
-            return self._error_result(spec, e, system=True)
+            return self._attach_borrows(spec, self._error_result(spec, e, system=True))
         except BaseException as e:  # noqa: BLE001
-            return self._error_result(spec, e)
+            return self._attach_borrows(spec, self._error_result(spec, e))
+
+    def _attach_borrows(self, spec: ts.TaskSpec, result: dict) -> dict:
+        """Refs deserialized here that survive the task are borrows; announce
+        them in the reply (submitter-owned, so registration beats the arg
+        unpin) or straight to their owner (cross-owner refs)."""
+        try:
+            borrows = []
+            for oid_hex, owner in self.report_new_borrows():
+                if owner == spec.owner_addr:
+                    borrows.append((oid_hex, self.address))
+                else:
+                    # third-party owner: ACK before replying — once we reply,
+                    # the submitter may release ITS borrow, and an async add
+                    # racing that release lets the owner free the object
+                    # while we still hold a ref (same rule as
+                    # _grant_result_borrows)
+                    try:
+                        self.io.run(
+                            self._notify_owner(
+                                owner, "add_borrow", oid_hex=oid_hex,
+                                addr=self.address,
+                            ),
+                            timeout=30,
+                        )
+                    except Exception:  # noqa: BLE001 - owner may be gone
+                        logger.warning("borrow report to %s failed", owner)
+            if borrows:
+                result["borrows"] = borrows
+        except Exception:  # noqa: BLE001 - never fail a task on bookkeeping
+            logger.exception("borrow reporting failed")
+        return result
 
     def _success_result(self, spec: ts.TaskSpec, result) -> dict:
         n = spec.num_returns
@@ -100,9 +131,12 @@ class WorkerAgent(CoreWorker):
                 ),
             )
         entries = []
+        granted = []
         for i, v in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
-            data = serialization.serialize(v).to_bytes()
+            ser = serialization.serialize(v)
+            data = ser.to_bytes()
+            granted.extend(self._grant_result_borrows(spec, ser.contained_refs))
             if len(data) <= _config.max_direct_call_object_size:
                 entries.append(("inline", data))
             else:
@@ -120,7 +154,46 @@ class WorkerAgent(CoreWorker):
                         },
                     )
                 )
-        return {"results": entries}
+        out = {"results": entries}
+        if granted:
+            out["granted"] = granted
+        return out
+
+    def _grant_result_borrows(self, spec: ts.TaskSpec, contained_refs):
+        """ObjectRefs inside a return value outlive this task frame in the
+        CALLER's hands. Register the caller as a borrower with each ref's
+        owner BEFORE replying — for self-owned refs the task-frame exit
+        would otherwise free them (no local refs, no pending, no borrowers)
+        while the caller still holds the nested ref. The caller releases via
+        the granted list in _store_task_result."""
+        granted = []
+        for r in contained_refs:
+            owner = r.owner_addr
+            if owner == spec.owner_addr:
+                continue  # caller owns it already, no borrow needed
+            key = r.id.binary()
+            if self._is_owner(owner):
+                entry = self._owned.get(key)
+                if entry is None:
+                    continue
+                entry["borrowers"].add(spec.owner_addr)
+                granted.append((r.id.hex(), self.address))
+            else:
+                # third-party owner: register the caller by proxy, and ACK
+                # before replying — our own borrow releases at frame exit,
+                # so an async add could lose the race with the free
+                try:
+                    self.io.run(
+                        self._notify_owner(
+                            owner, "add_borrow", oid_hex=r.id.hex(),
+                            addr=spec.owner_addr,
+                        ),
+                        timeout=30,
+                    )
+                    granted.append((r.id.hex(), owner))
+                except Exception:  # noqa: BLE001 - owner may be gone
+                    logger.warning("borrow grant to %s failed", owner)
+        return granted
 
     def _error_result(self, spec: ts.TaskSpec, e: BaseException, system=False) -> dict:
         err = e if isinstance(e, exc.RayTpuError) else exc.TaskError.from_exception(e)
@@ -190,9 +263,9 @@ class WorkerAgent(CoreWorker):
 
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
-            return self._success_result(spec, result)
+            return self._attach_borrows(spec, self._success_result(spec, result))
         except BaseException as e:  # noqa: BLE001
-            return self._error_result(spec, e)
+            return self._attach_borrows(spec, self._error_result(spec, e))
 
 
 def main():
@@ -217,8 +290,22 @@ def main():
     api._worker.backend = ClusterBackend(core_worker=agent)
     api._worker.mode = "worker"
 
-    # serve until killed (all work arrives over RPC)
-    threading.Event().wait()
+    # Serve until killed (all work arrives over RPC), but never outlive the
+    # raylet: workers are children of the raylet process, so a dead raylet
+    # reparents us to init and closes our raylet connection. Without this
+    # watchdog, SIGKILL'd raylets (chaos tests, real crashes) orphan workers
+    # forever. Parity: worker exit on raylet disconnect
+    # (core_worker.cc Exit on raylet channel failure).
+    parent = os.getppid()
+    stop = threading.Event()
+    while not stop.wait(1.0):
+        if agent.raylet is not None and agent.raylet.closed:
+            logger.info("raylet connection closed; exiting")
+            break
+        if os.getppid() != parent:
+            logger.info("raylet process died (reparented); exiting")
+            break
+    os._exit(0)
 
 
 if __name__ == "__main__":

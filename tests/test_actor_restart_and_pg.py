@@ -117,3 +117,39 @@ def test_placement_group_infeasible_strict_spread(ray_cluster2):
         [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD"
     )
     assert not pg.ready(timeout=5)
+
+
+def test_pg_actor_draws_from_bundle_not_node(ray_cluster2):
+    """Round-3 regression: an actor placed in a PG must consume the bundle's
+    reservation, not node availability — double-booking starved every plain
+    task while a WorkerGroup was alive (the Train+Data deadlock)."""
+    ray = ray_cluster2
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray.remote
+    class Holder:
+        def ping(self):
+            return 1
+
+    a = Holder.options(
+        placement_group=pg, placement_group_bundle_index=0, num_cpus=1
+    ).remote()
+    assert ray.get(a.ping.remote(), timeout=30) == 1
+
+    # node had 2 CPUs; PG reserved 1; the actor lives INSIDE that bundle, so
+    # 1 CPU must remain for plain tasks
+    assert ray.available_resources().get("CPU", 0) == 1.0
+
+    @ray.remote
+    def plain():
+        return "ok"
+
+    assert ray.get(plain.remote(), timeout=60) == "ok"
+
+    ray.kill(a)
+    remove_placement_group(pg)
+    time.sleep(2)
+    assert ray.available_resources().get("CPU") == 2.0

@@ -166,3 +166,27 @@ def test_cluster_resources_reported(ray_cluster):
     assert res.get("CPU") == 2.0
     nodes = ray.nodes()
     assert len(nodes) == 1 and nodes[0]["Alive"]
+
+
+def test_actor_call_ordering_pipelined(ray_cluster):
+    """Round-3: actor submission pipelines up to actor_max_inflight_calls;
+    execution order must still equal submission order (TCP frame order +
+    single-thread executor on the worker)."""
+    ray = ray_cluster
+
+    @ray.remote
+    class Log:
+        def __init__(self):
+            self.seen = []
+
+        def add(self, i):
+            self.seen.append(i)
+            return i
+
+        def seen_list(self):
+            return self.seen
+
+    log = Log.remote()
+    refs = [log.add.remote(i) for i in range(200)]
+    assert ray.get(refs, timeout=120) == list(range(200))
+    assert ray.get(log.seen_list.remote(), timeout=60) == list(range(200))

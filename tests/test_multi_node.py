@@ -117,3 +117,54 @@ def test_node_death_detected_and_task_fails(two_node_cluster):
             break
         time.sleep(1)
     assert len([n for n in ray.nodes() if n["Alive"]]) == 1
+
+
+def test_workers_exit_when_raylet_killed():
+    """SIGKILL'd raylets must not orphan their worker processes: each worker
+    watches its raylet connection + parent pid and exits (worker_main
+    watchdog). Regression: round-3 leak (285 orphans accumulated)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    def node_worker_pids(node_id: str):
+        pids = []
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read()
+                if b"worker_main" not in cmd:
+                    continue
+                with open(f"/proc/{pid}/environ", "rb") as f:
+                    env = f.read()
+                if f"RAY_TPU_NODE_ID={node_id}".encode() in env:
+                    pids.append(int(pid))
+            except (OSError, PermissionError):
+                continue
+        return pids
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    victim = cluster.add_node(num_cpus=1, resources={"side": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"side": 1})
+        def touch():
+            return os.getpid()
+
+        ray_tpu.get(touch.remote(), timeout=60)
+        assert node_worker_pids(victim), "victim node should have live workers"
+
+        cluster.kill_node(victim)
+        deadline = time.time() + 15
+        while node_worker_pids(victim) and time.time() < deadline:
+            time.sleep(0.5)
+        assert node_worker_pids(victim) == [], "workers must exit with raylet"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
