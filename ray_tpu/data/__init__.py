@@ -1,0 +1,26 @@
+"""Data layer: lazy block Datasets with streaming execution into TPU HBM.
+
+See SURVEY.md §2.5; reference: python/ray/data/. Blocks are numpy-column
+dicts, execution is pull-based over remote tasks/actor pools, and
+iter_batches double-buffers device_put.
+"""
+
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    range,
+    read_csv,
+    read_parquet,
+)
+from ray_tpu.data.executor import ActorPoolStrategy
+
+__all__ = [
+    "ActorPoolStrategy",
+    "Dataset",
+    "from_items",
+    "from_numpy",
+    "range",
+    "read_csv",
+    "read_parquet",
+]

@@ -1,0 +1,75 @@
+"""Batch iteration with double-buffered device transfer.
+
+Parity: data/iterator.py:234 (`iter_torch_batches`) — the accelerator-feeding
+edge of the Data layer. TPU-native shape: batches are assembled on host
+(zero-copy out of the shm store where possible), then `jax.device_put` with
+an optional NamedSharding; a one-batch prefetch pipeline keeps the transfer
+of batch N+1 overlapped with compute on batch N (double buffering — the
+device_put is async, so issuing it early is all the overlap XLA needs).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, block_concat, block_num_rows, block_slice
+
+
+def _host_batches(
+    block_refs: Iterator[Any], batch_size: int, drop_last: bool
+) -> Iterator[Block]:
+    """Assemble exact-size host batches from a stream of block refs."""
+    import ray_tpu
+
+    buf = []
+    buffered = 0
+    for ref in block_refs:
+        block = ray_tpu.get(ref)
+        if block_num_rows(block) == 0:
+            continue
+        buf.append(block)
+        buffered += block_num_rows(block)
+        while buffered >= batch_size:
+            merged = block_concat(buf)
+            yield block_slice(merged, 0, batch_size)
+            rest = block_slice(merged, batch_size, buffered)
+            buf = [rest] if block_num_rows(rest) else []
+            buffered -= batch_size
+    if buffered and not drop_last:
+        yield block_concat(buf)
+
+
+def iter_batches(
+    block_refs: Iterator[Any],
+    *,
+    batch_size: int = 256,
+    prefetch_batches: int = 1,
+    drop_last: bool = False,
+    device: Any = None,
+    sharding: Any = None,
+) -> Iterator[Dict[str, Any]]:
+    """Yield dict-of-array batches. With `device`/`sharding` set, batches are
+    jax arrays already resident (or in flight) on the accelerator; the
+    prefetch window issues transfers ahead of consumption."""
+    host_iter = _host_batches(block_refs, batch_size, drop_last)
+    if device is None and sharding is None:
+        yield from host_iter
+        return
+
+    import jax
+
+    def put(batch: Block):
+        target = sharding if sharding is not None else device
+        return jax.device_put(batch, target)
+
+    window: collections.deque = collections.deque()
+    depth = max(1, prefetch_batches + 1)  # N in compute + N+1 in transfer
+    for batch in host_iter:
+        window.append(put(batch))
+        if len(window) >= depth:
+            yield window.popleft()
+    while window:
+        yield window.popleft()

@@ -1,0 +1,193 @@
+"""Data layer tests: blocks, datasources, streaming execution, iteration,
+Train integration.
+
+Parity model: python/ray/data/tests/ (operator tests with in-memory blocks,
+streaming executor tests — SURVEY.md §4.5).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data.block import (
+    block_concat,
+    block_from_rows,
+    block_num_rows,
+    block_slice,
+)
+from ray_tpu.data.executor import ActorPoolStrategy
+
+
+class TestBlocks:
+    def test_rows_roundtrip(self):
+        b = block_from_rows([{"a": 1, "b": 2.0}, {"a": 3, "b": 4.0}])
+        assert block_num_rows(b) == 2
+        assert b["a"].tolist() == [1, 3]
+        b2 = block_from_rows([10, 20, 30])
+        assert b2["item"].tolist() == [10, 20, 30]
+
+    def test_concat_slice(self):
+        b1 = {"x": np.arange(3)}
+        b2 = {"x": np.arange(3, 7)}
+        cat = block_concat([b1, b2])
+        assert block_num_rows(cat) == 7
+        assert block_slice(cat, 2, 5)["x"].tolist() == [2, 3, 4]
+
+
+class TestDatasetLocal:
+    def test_range_count_take(self, ray_start_local):
+        ds = rd.range(100, parallelism=4)
+        assert ds.count() == 100
+        assert ds.take(5) == [{"id": 0}, {"id": 1}, {"id": 2}, {"id": 3}, {"id": 4}]
+
+    def test_map_batches_streaming(self, ray_start_local):
+        ds = rd.range(64, parallelism=4).map_batches(
+            lambda b: {"id": b["id"], "sq": b["id"] ** 2}
+        )
+        rows = ds.take_all()
+        assert len(rows) == 64
+        assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+    def test_chained_map_and_filter(self, ray_start_local):
+        ds = (
+            rd.range(50, parallelism=4)
+            .map_batches(lambda b: {"id": b["id"] * 2})
+            .filter(lambda r: r["id"] % 4 == 0)
+        )
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(0, 100, 4))
+
+    def test_map_batches_with_batch_size(self, ray_start_local):
+        def stamp_size(b):
+            n = block_num_rows(b)
+            return {"id": b["id"], "bs": np.full(n, n)}
+
+        ds = rd.range(100, parallelism=3).map_batches(stamp_size, batch_size=32)
+        rows = ds.take_all()
+        assert len(rows) == 100
+        # rechunked: 32/32/32/4 — every row stamped with its batch's size
+        from collections import Counter
+
+        counts = Counter(r["bs"] for r in rows)
+        assert counts == {32: 96, 4: 4}
+
+    def test_actor_pool_callable_class(self, ray_start_regular):
+        class AddConst:
+            def __init__(self, c):
+                self.c = c
+
+            def __call__(self, block):
+                return {"id": block["id"] + self.c}
+
+        ds = rd.range(40, parallelism=4).map_batches(
+            AddConst, fn_args=(1000,), compute=ActorPoolStrategy(size=2)
+        )
+        rows = sorted(r["id"] for r in ds.take_all())
+        assert rows == list(range(1000, 1040))
+
+    def test_limit(self, ray_start_local):
+        assert rd.range(1000, parallelism=8).limit(17).count() == 17
+
+    def test_from_items_and_numpy(self, ray_start_local):
+        ds = rd.from_items([{"v": i} for i in range(10)])
+        assert ds.count() == 10
+        ds2 = rd.from_numpy(np.ones((5, 3)))
+        assert ds2.count() == 5
+        assert ds2.take(1)[0]["data"].shape == (3,)
+
+    def test_split_balanced(self, ray_start_local):
+        shards = rd.range(103, parallelism=5).split(4)
+        counts = [s.count() for s in shards]
+        assert sum(counts) == 103
+        assert max(counts) - min(counts) <= 3
+        # shards are disjoint and cover the range
+        ids = sorted(r["id"] for s in shards for r in s.take_all())
+        assert ids == list(range(103))
+
+    def test_iter_batches_exact_sizes(self, ray_start_local):
+        batches = list(
+            rd.range(70, parallelism=3).iter_batches(batch_size=32)
+        )
+        assert [len(b["id"]) for b in batches] == [32, 32, 6]
+        batches = list(
+            rd.range(70, parallelism=3).iter_batches(batch_size=32, drop_last=True)
+        )
+        assert [len(b["id"]) for b in batches] == [32, 32]
+
+    def test_iter_batches_to_device(self, ray_start_local):
+        import jax
+
+        dev = jax.devices("cpu")[0]
+        batches = list(
+            rd.range(16, parallelism=2).iter_batches(batch_size=8, device=dev)
+        )
+        assert len(batches) == 2
+        assert isinstance(batches[0]["id"], jax.Array)
+        assert batches[0]["id"].sum() == sum(range(8))
+
+
+class TestFileIO:
+    def test_parquet_roundtrip(self, ray_start_local, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        for i in range(3):
+            t = pa.table({"x": list(range(i * 10, i * 10 + 10)),
+                          "y": [float(v) for v in range(10)]})
+            pq.write_table(t, str(tmp_path / f"part-{i}.parquet"))
+        ds = rd.read_parquet(str(tmp_path))
+        assert ds.count() == 30
+        assert ds.schema()["x"] == "int64"
+        assert sorted(r["x"] for r in ds.take_all()) == list(range(30))
+
+    def test_csv(self, ray_start_local, tmp_path):
+        pytest.importorskip("pyarrow")
+        p = tmp_path / "data.csv"
+        p.write_text("a,b\n1,x\n2,y\n3,z\n")
+        ds = rd.read_csv(str(p))
+        assert ds.count() == 3
+        assert ds.take(1)[0]["a"] == 1
+
+
+class TestTrainIntegration:
+    def test_trainer_feeds_from_dataset(self, ray_start_regular):
+        """JaxTrainer ingests a Dataset via get_dataset_shard → iter_batches
+        (VERDICT round-2 item 4: train from a Dataset, not synthetic_batch)."""
+        from ray_tpu.train import JaxTrainer, ScalingConfig, get_dataset_shard, report
+
+        ds = rd.range(64, parallelism=4).map_batches(
+            lambda b: {"x": b["id"].astype(np.float32),
+                       "y": (b["id"] * 3 + 1).astype(np.float32)}
+        )
+
+        def train_loop(config):
+            import jax
+            import jax.numpy as jnp
+
+            shard = get_dataset_shard("train")
+            w = jnp.zeros(2)  # fit y = a*x + b
+            seen = 0
+            for _ in range(3):  # epochs
+                for batch in shard.iter_batches(batch_size=8):
+                    x, y = jnp.asarray(batch["x"]), jnp.asarray(batch["y"])
+                    seen += int(x.shape[0])
+
+                    def loss(w):
+                        return jnp.mean((w[0] * x + w[1] - y) ** 2)
+
+                    w = w - 0.01 * jax.grad(loss)(w)
+            report({"rows_seen": seen, "final_loss": float(
+                jnp.mean((w[0] * jnp.asarray(batch["x"]) + w[1]
+                          - jnp.asarray(batch["y"])) ** 2))})
+
+        trainer = JaxTrainer(
+            train_loop,
+            scaling_config=ScalingConfig(num_workers=2, use_tpu=False),
+            datasets={"train": ds},
+        )
+        result = trainer.fit()
+        assert result.error is None
+        # each of 2 workers saw its 32-row shard 3 times
+        assert result.metrics["rows_seen"] == 96
+        all_ranks = result.metrics["_all_ranks"]
+        assert set(all_ranks) == {0, 1}
+        assert all(m["rows_seen"] == 96 for m in all_ranks.values())
