@@ -8,6 +8,7 @@ from ray_tpu.train.config import (
 from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     get_local_rank,
     get_world_rank,
     get_world_size,
@@ -29,6 +30,7 @@ __all__ = [
     "report",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "get_world_rank",
     "get_world_size",
     "get_local_rank",

@@ -30,9 +30,11 @@ class _Session:
     the module-level functions below."""
 
     def __init__(self, context: TrainContext,
-                 latest_checkpoint: Optional[Checkpoint] = None):
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.context = context
         self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.result_queue: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -74,6 +76,17 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return _get_session().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's shard of the Dataset passed to the trainer
+    (parity: ray.train.get_dataset_shard / air.session :43)."""
+    shards = _get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; trainer datasets={list(shards)}"
+        )
+    return shards[name]
 
 
 def get_context() -> TrainContext:

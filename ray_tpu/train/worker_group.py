@@ -53,9 +53,20 @@ class TrainWorker:
 
     def setup_jax_distributed(self, coordinator: str, num_processes: int,
                               process_id: int) -> bool:
-        """jax.distributed over ICI/DCN — the NCCL-rendezvous replacement."""
+        """jax.distributed over ICI/DCN — the NCCL-rendezvous replacement.
+
+        Re-entrant: a retried rendezvous round (coordinator port stolen on
+        another rank) reaches workers that DID initialize in the failed
+        round — tear that state down first or jax raises 'already
+        initialized' and the retry loop can never succeed."""
         import jax
 
+        if self._distributed_ready:
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 - half-initialized state
+                pass
+            self._distributed_ready = False
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -66,14 +77,15 @@ class TrainWorker:
 
     # ------------------------------------------------------------ training
     def start_training(self, fn: Callable, config: Dict[str, Any],
-                       latest_checkpoint: Optional[Checkpoint] = None) -> bool:
+                       latest_checkpoint: Optional[Checkpoint] = None,
+                       dataset_shards: Optional[Dict[str, Any]] = None) -> bool:
         ctx = TrainContext(
             world_rank=self.rank,
             world_size=self.world_size,
             local_rank=0,
             experiment_name=self.experiment_name,
         )
-        self.session = _Session(ctx, latest_checkpoint)
+        self.session = _Session(ctx, latest_checkpoint, dataset_shards)
 
         def run():
             _set_session(self.session)
@@ -158,17 +170,35 @@ class WorkerGroup:
         ]
         return ray_tpu.get(refs, timeout=timeout)
 
-    def rendezvous(self):
-        """jax.distributed bootstrap across the group (no-op for 1 worker)."""
+    def rendezvous(self, attempts: int = 3):
+        """jax.distributed bootstrap across the group (no-op for 1 worker).
+
+        The coordinator port is picked by probing a free port on worker 0 and
+        releasing it — inherently TOCTOU — so the whole round retries with a
+        fresh port if another process stole it between probe and bind
+        (advisor finding r1/r2)."""
         if self.num_workers <= 1:
             return
-        infos = self.for_all("host_info")
-        coordinator = f"{infos[0]['ip']}:{infos[0]['port']}"
-        refs = [
-            w.setup_jax_distributed.remote(coordinator, self.num_workers, rank)
-            for rank, w in enumerate(self.workers)
-        ]
-        ray_tpu.get(refs, timeout=300)
+        last_err: Optional[BaseException] = None
+        for _ in range(attempts):
+            infos = self.for_all("host_info")
+            coordinator = f"{infos[0]['ip']}:{infos[0]['port']}"
+            refs = [
+                w.setup_jax_distributed.remote(
+                    coordinator, self.num_workers, rank
+                )
+                for rank, w in enumerate(self.workers)
+            ]
+            try:
+                ray_tpu.get(refs, timeout=300)
+                return
+            except Exception as e:  # noqa: BLE001 - port stolen / bind race
+                last_err = e
+                if "address" not in str(e).lower() and "bind" not in str(e).lower():
+                    raise
+        raise RuntimeError(
+            f"rendezvous failed after {attempts} port attempts"
+        ) from last_err
 
     def shutdown(self):
         for w in self.workers:
