@@ -1,0 +1,31 @@
+"""Serve-equivalent model serving layer (SURVEY.md §2.8).
+
+Declarative deployments reconciled by a detached controller actor; the data
+plane (handles, HTTP proxy) routes power-of-two-choices directly to replica
+actors.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    deployment,
+    get_handle,
+    http_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.deployment import AutoscalingConfig, Deployment
+
+__all__ = [
+    "AutoscalingConfig",
+    "Deployment",
+    "delete",
+    "deployment",
+    "get_handle",
+    "http_address",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,103 @@
+"""Serve public API: serve.run / serve.get_handle / serve.shutdown.
+
+Parity: python/ray/serve/api.py (`serve.run`, `serve.start`,
+`@serve.deployment` re-exported from deployment.py). The controller is a
+detached named actor, so multiple drivers share one Serve instance per
+cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.deployment import Deployment, deployment  # noqa: F401
+
+CONTROLLER_NAME = "__serve_controller"
+_local: Dict[str, Any] = {}
+
+
+def start() -> Any:
+    """Ensure the Serve controller exists; returns its handle."""
+    import ray_tpu
+
+    from ray_tpu.serve.controller import ServeController
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001 - not created yet
+        actor_cls = ray_tpu.remote(num_cpus=0, max_concurrency=16)(ServeController)
+        try:
+            controller = actor_cls.options(
+                name=CONTROLLER_NAME, lifetime="detached", get_if_exists=True
+            ).remote()
+        except Exception:  # noqa: BLE001 - lost naming race
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    _local["controller"] = controller
+    return controller
+
+
+def run(target: Deployment, *, blocking: bool = False,
+        http: bool = False) -> Any:
+    """Deploy `target` (and start the HTTP proxy if asked); returns a handle.
+
+    The reference's serve.run takes an Application graph; single-deployment
+    apps (the overwhelmingly common case) pass the Deployment directly.
+    """
+    import ray_tpu
+
+    controller = start()
+    ray_tpu.get(controller.deploy.remote(target), timeout=60)
+    if http and "proxy" not in _local:
+        from ray_tpu.serve.http_proxy import HTTPProxy
+
+        _local["proxy"] = HTTPProxy(controller)
+    handle = get_handle(target.name)
+    # wait for at least one replica
+    handle._router.assign_request  # noqa: B018 - attribute check
+    if blocking:  # pragma: no cover - interactive use
+        import time
+
+        while True:
+            time.sleep(3600)
+    return handle
+
+
+def get_handle(deployment_name: str):
+    from ray_tpu.serve.handle import DeploymentHandle, Router
+
+    controller = _local.get("controller") or start()
+    router = _local.setdefault("router", Router(controller))
+    return DeploymentHandle(deployment_name, router)
+
+
+def http_address() -> Optional[str]:
+    proxy = _local.get("proxy")
+    return proxy.address() if proxy else None
+
+
+def delete(deployment_name: str) -> None:
+    import ray_tpu
+
+    controller = _local.get("controller") or start()
+    ray_tpu.get(controller.delete_deployment.remote(deployment_name), timeout=60)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    controller = _local.get("controller") or start()
+    return ray_tpu.get(controller.status.remote(), timeout=60)
+
+
+def shutdown() -> None:
+    import ray_tpu
+
+    controller = _local.pop("controller", None)
+    _local.pop("router", None)
+    _local.pop("proxy", None)
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=60)
+            ray_tpu.kill(controller)
+        except Exception:  # noqa: BLE001
+            pass

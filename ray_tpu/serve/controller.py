@@ -1,0 +1,189 @@
+"""ServeController: declarative target state → replica actor fleet.
+
+Parity: serve/controller.py:79 (`ServeController` reconciliation loop) +
+_private/deployment_state.py:1103 (`DeploymentState` replica state machine:
+STARTING → RUNNING → STOPPING, dead replicas replaced). Runs as a detached
+named actor; handles/proxies pull the routing table by version (the
+long-poll LongPollHost analog, long_poll.py:186).
+
+Autoscaling: replica-reported ongoing-request counts drive the target count
+between min/max (autoscaling_policy.py analog), evaluated each reconcile
+tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "__serve_controller"
+
+
+class _ReplicaSet:
+    def __init__(self):
+        self.actors: List[Any] = []          # ActorHandles
+        self.target: int = 0
+        self.last_scale_change: float = 0.0
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, Any] = {}     # name → Deployment
+        self._replicas: Dict[str, _ReplicaSet] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ target API
+    def deploy(self, deployment) -> bool:
+        with self._lock:
+            self._deployments[deployment.name] = deployment
+            rs = self._replicas.setdefault(deployment.name, _ReplicaSet())
+            rs.target = (
+                deployment.autoscaling_config.min_replicas
+                if deployment.autoscaling_config else deployment.num_replicas
+            )
+        self._reconcile()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            self._deployments.pop(name, None)
+            rs = self._replicas.pop(name, None)
+        if rs:
+            self._stop_replicas(rs.actors)
+        self._bump()
+        return True
+
+    def routing_table(self, known_version: int = -1) -> Optional[dict]:
+        """Returns {version, deployments: {name: [replica handles]}} or None
+        when the caller's version is current (cheap poll)."""
+        if known_version == self._version:
+            return None
+        with self._lock:
+            return {
+                "version": self._version,
+                "deployments": {
+                    name: list(rs.actors) for name, rs in self._replicas.items()
+                },
+                "routes": {
+                    d.route: name for name, d in self._deployments.items()
+                },
+            }
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                name: {"target": rs.target, "running": len(rs.actors)}
+                for name, rs in self._replicas.items()
+            }
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        for rs in self._replicas.values():
+            self._stop_replicas(rs.actors)
+        self._replicas.clear()
+        return True
+
+    # --------------------------------------------------------- reconciliation
+    def _bump(self):
+        self._version += 1
+
+    def _reconcile_loop(self):
+        while not self._stop.wait(1.0):
+            try:
+                self._autoscale()
+                self._reconcile()
+            except Exception:  # noqa: BLE001 - loop must survive
+                logger.exception("serve reconcile error")
+
+    def _reconcile(self):
+        import ray_tpu
+
+        with self._lock:
+            items = list(self._deployments.items())
+        changed = False
+        for name, dep in items:
+            rs = self._replicas.get(name)
+            if rs is None:
+                continue
+            # drop dead replicas (replaced next tick)
+            alive = []
+            for a in rs.actors:
+                try:
+                    ray_tpu.get(a.check_health.remote(), timeout=10)
+                    alive.append(a)
+                except Exception:  # noqa: BLE001 - replica died
+                    changed = True
+            rs.actors = alive
+            while len(rs.actors) < rs.target:
+                rs.actors.append(self._start_replica(dep))
+                changed = True
+            while len(rs.actors) > rs.target:
+                extra = rs.actors.pop()
+                self._stop_replicas([extra])
+                changed = True
+        if changed:
+            self._bump()
+
+    def _start_replica(self, dep):
+        import ray_tpu
+
+        from ray_tpu.serve.replica import ServeReplica
+
+        opts = dict(dep.ray_actor_options)
+        opts.setdefault("num_cpus", 1)
+        opts.setdefault("max_concurrency", dep.max_ongoing_requests)
+        actor_cls = ray_tpu.remote(**opts)(ServeReplica)
+        return actor_cls.remote(dep.func_or_class, dep.init_args, dep.init_kwargs)
+
+    def _stop_replicas(self, actors):
+        import ray_tpu
+
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _autoscale(self):
+        import ray_tpu
+
+        with self._lock:
+            items = list(self._deployments.items())
+        now = time.monotonic()
+        for name, dep in items:
+            ac = dep.autoscaling_config
+            rs = self._replicas.get(name)
+            if ac is None or rs is None or not rs.actors:
+                continue
+            try:
+                ongoing = ray_tpu.get(
+                    [a.num_ongoing_requests.remote() for a in rs.actors],
+                    timeout=10,
+                )
+            except Exception:  # noqa: BLE001 - racing replica death
+                continue
+            avg = sum(ongoing) / max(len(ongoing), 1)
+            target = rs.target
+            if avg > ac.target_ongoing_requests and (
+                now - rs.last_scale_change > ac.upscale_delay_s
+            ):
+                target = min(rs.target + 1, ac.max_replicas)
+            elif avg < ac.target_ongoing_requests / 2 and (
+                now - rs.last_scale_change > ac.downscale_delay_s
+            ):
+                target = max(rs.target - 1, ac.min_replicas)
+            if target != rs.target:
+                logger.info("autoscale %s: %d -> %d (avg ongoing %.1f)",
+                            name, rs.target, target, avg)
+                rs.target = target
+                rs.last_scale_change = now

@@ -1,0 +1,77 @@
+"""Deployment descriptor + decorator.
+
+Parity: python/ray/serve/deployment.py:97 (`Deployment`) and the
+`@serve.deployment` decorator (serve/api.py). A deployment is a declarative
+target: user class/function + replica count + actor options; the controller
+reconciles reality to it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    route_prefix: Optional[str] = None
+
+    def options(self, **kwargs) -> "Deployment":
+        return replace(self, **kwargs)
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Fix constructor args (the reference's deployment-graph bind)."""
+        return replace(self, init_args=args, init_kwargs=kwargs)
+
+    @property
+    def route(self) -> str:
+        return self.route_prefix or f"/{self.name}"
+
+
+def deployment(
+    _func_or_class: Optional[Any] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 8,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    autoscaling_config: Optional[Any] = None,
+    route_prefix: Optional[str] = None,
+):
+    """@serve.deployment — wraps a class or function into a Deployment."""
+
+    def make(target):
+        if isinstance(autoscaling_config, dict):
+            ac = AutoscalingConfig(**autoscaling_config)
+        else:
+            ac = autoscaling_config
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling_config=ac,
+            route_prefix=route_prefix,
+        )
+
+    if _func_or_class is not None:
+        return make(_func_or_class)
+    return make

@@ -1,0 +1,106 @@
+"""DeploymentHandle + Router: the data plane.
+
+Parity: serve/handle.py:239 (`RayServeHandle.remote`) and
+_private/router.py:368/:434 — requests go straight to a replica picked by
+power-of-two-choices over per-replica in-flight counts the router tracks
+locally; the routing table refreshes from the controller only when its
+version moves (long-poll analog). The controller is never on the request
+path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Router:
+    def __init__(self, controller_handle):
+        self._controller = controller_handle
+        self._version = -1
+        self._replicas: Dict[str, List[Any]] = {}
+        self._routes: Dict[str, str] = {}
+        self._inflight: Dict[str, Dict[int, int]] = {}  # dep → idx → count
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False) -> None:
+        import ray_tpu
+
+        now = time.monotonic()
+        if not force and now - self._last_refresh < 0.5:
+            return
+        self._last_refresh = now
+        table = ray_tpu.get(
+            self._controller.routing_table.remote(self._version), timeout=30
+        )
+        if table is None:
+            return
+        with self._lock:
+            self._version = table["version"]
+            self._replicas = table["deployments"]
+            self._routes = table.get("routes", {})
+            for name, replicas in self._replicas.items():
+                counts = self._inflight.setdefault(name, {})
+                for idx in range(len(replicas)):
+                    counts.setdefault(idx, 0)
+
+    def deployment_for_route(self, path: str) -> Optional[str]:
+        self._refresh()
+        return self._routes.get(path)
+
+    def assign_request(self, deployment: str, *args, **kwargs):
+        """Pick a replica (power of two choices on local in-flight counts)
+        and dispatch; returns the ObjectRef."""
+        self._refresh()
+        deadline = time.monotonic() + 30
+        while True:
+            with self._lock:
+                replicas = self._replicas.get(deployment) or []
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {deployment!r}"
+                )
+            time.sleep(0.1)
+            self._refresh(force=True)
+        with self._lock:
+            counts = self._inflight.setdefault(deployment, {})
+            if len(replicas) == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(len(replicas)), 2)
+                idx = a if counts.get(a, 0) <= counts.get(b, 0) else b
+            counts[idx] = counts.get(idx, 0) + 1
+        ref = replicas[idx].handle_request.remote(*args, **kwargs)
+        self._track_completion(deployment, idx, ref)
+        return ref
+
+    def _track_completion(self, deployment: str, idx: int, ref) -> None:
+        import ray_tpu
+
+        def done(_):
+            with self._lock:
+                counts = self._inflight.get(deployment)
+                if counts and counts.get(idx, 0) > 0:
+                    counts[idx] -= 1
+
+        try:
+            ref.future().add_done_callback(done)
+        except Exception:  # noqa: BLE001 - backend without futures
+            with self._lock:
+                self._inflight[deployment][idx] -= 1
+
+
+class DeploymentHandle:
+    """User-facing handle: `handle.remote(...)` → ObjectRef (get for result)."""
+
+    def __init__(self, deployment_name: str, router: Router):
+        self.deployment_name = deployment_name
+        self._router = router
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign_request(self.deployment_name, *args, **kwargs)

@@ -1,0 +1,102 @@
+"""HTTP proxy: a dependency-free asyncio HTTP/1.1 front end.
+
+Parity: serve/_private/http_proxy.py:320 (`HTTPProxy` actor) — routes
+`GET/POST <route_prefix>` to the deployment's replicas through the Router
+(never the controller). The reference uses uvicorn/ASGI; this image has no
+ASGI server baked in, so a minimal HTTP/1.1 loop over asyncio streams covers
+the JSON request/response path the tests and examples need.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class HTTPProxy:
+    def __init__(self, controller_handle, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.serve.handle import Router
+
+        self._router = Router(controller_handle)
+        self.host = host
+        self.port = port
+        self._started = threading.Event()
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=30)
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._serve())
+
+    async def _serve(self):
+        server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = server.sockets[0].getsockname()[1]
+        self._started.set()
+        async with server:
+            await server.serve_forever()
+
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter):
+        try:
+            request_line = await reader.readline()
+            parts = request_line.decode("latin1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1]
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            n = int(headers.get("content-length", 0) or 0)
+            if n:
+                body = await reader.readexactly(n)
+
+            status, payload = await asyncio.get_running_loop().run_in_executor(
+                None, self._dispatch, method, path, body
+            )
+            data = json.dumps(payload, default=str).encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(data)}\r\nConnection: close\r\n\r\n"
+                .encode() + data
+            )
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _dispatch(self, method: str, path: str, body: bytes):
+        import ray_tpu
+
+        if path == "/-/healthz":
+            return "200 OK", {"status": "ok"}
+        name = self._router.deployment_for_route(path)
+        if name is None:
+            return "404 Not Found", {"error": f"no route {path}"}
+        args = ()
+        if body:
+            try:
+                args = (json.loads(body),)
+            except json.JSONDecodeError:
+                args = (body.decode("utf-8", "replace"),)
+        try:
+            ref = self._router.assign_request(name, *args)
+            result = ray_tpu.get(ref, timeout=60)
+            return "200 OK", {"result": result}
+        except Exception as e:  # noqa: BLE001 - surface as 500
+            return "500 Internal Server Error", {"error": str(e)}

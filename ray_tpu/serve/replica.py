@@ -1,0 +1,55 @@
+"""Replica actor: executes requests for one deployment copy.
+
+Parity: serve/_private/replica.py:384 (`RayServeReplica`; handle_request
+:639). The replica wraps the user callable (class instance or function),
+tracks its in-flight count for the router's power-of-two-choices, and
+exposes a health check for the controller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any
+
+
+class ServeReplica:
+    def __init__(self, func_or_class, init_args, init_kwargs):
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+        else:
+            self._callable = func_or_class
+        self._ongoing = 0
+        self._total = 0
+
+    def handle_request(self, *args, **kwargs) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            target = self._callable
+            if not callable(target):
+                raise TypeError(f"deployment target {target!r} not callable")
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
+        finally:
+            self._ongoing -= 1
+
+    def num_ongoing_requests(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            user_check()
+        return True
+
+    def reconfigure(self, user_config) -> bool:
+        hook = getattr(self._callable, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+        return True
