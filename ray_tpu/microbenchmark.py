@@ -1,0 +1,110 @@
+"""Core-runtime microbenchmarks: `python -m ray_tpu.microbenchmark`.
+
+Parity: python/ray/_private/ray_perf.py:93 (`ray microbenchmark`) — measures
+the control plane's op throughput (get/put, task submission, actor calls) on
+a single-node cluster. Prints one line per benchmark and a JSON summary on
+the last line for scripted comparison.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable[[], int], duration: float = 2.0) -> Dict:
+    """Run fn repeatedly for ~duration seconds; fn returns ops performed."""
+    # warmup
+    fn()
+    start = time.perf_counter()
+    ops = 0
+    while time.perf_counter() - start < duration:
+        ops += fn()
+    dt = time.perf_counter() - start
+    rate = ops / dt
+    print(f"{name:<42s} {rate:>12,.1f} ops/s")
+    return {"name": name, "ops_per_s": round(rate, 1)}
+
+
+def main(duration: float = 2.0):
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    results = []
+
+    # ---------------------------------------------------------- put / get
+    small = b"x" * 1024
+    results.append(timeit(
+        "put small (1 KiB)", lambda: sum(1 for _ in range(20)
+                                         if ray_tpu.put(small)), duration))
+    ref_small = ray_tpu.put(small)
+    results.append(timeit(
+        "get small (1 KiB)", lambda: sum(1 for _ in range(20)
+                                         if ray_tpu.get(ref_small) is not None),
+        duration))
+    big = np.zeros(10 * 1024 * 1024 // 8)  # 10 MiB
+    results.append(timeit(
+        "put large (10 MiB)", lambda: sum(1 for _ in range(5)
+                                          if ray_tpu.put(big)), duration))
+    ref_big = ray_tpu.put(big)
+    results.append(timeit(
+        "get large (10 MiB, zero-copy)",
+        lambda: sum(1 for _ in range(5)
+                    if ray_tpu.get(ref_big) is not None), duration))
+
+    # --------------------------------------------------------------- tasks
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    # warm the worker pool so task benches measure dispatch, not process spawn
+    ray_tpu.get([noop.remote() for _ in range(16)])
+
+    results.append(timeit(
+        "task submit+get (sync, 1 in flight)",
+        lambda: sum(1 for _ in range(5) if ray_tpu.get(noop.remote()) == 0),
+        duration))
+
+    def batch_tasks():
+        n = 50
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        return n
+
+    results.append(timeit("task throughput (50 in flight)", batch_tasks, duration))
+
+    # -------------------------------------------------------------- actors
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    actor = Counter.remote()
+    ray_tpu.get(actor.inc.remote())
+    results.append(timeit(
+        "actor call (sync, 1 in flight)",
+        lambda: sum(1 for _ in range(10)
+                    if ray_tpu.get(actor.inc.remote())), duration))
+
+    def batch_actor_calls():
+        n = 100
+        ray_tpu.get([actor.inc.remote() for _ in range(n)])
+        return n
+
+    results.append(timeit(
+        "actor calls (100 in flight, pipelined)", batch_actor_calls, duration))
+
+    ray_tpu.shutdown()
+    print(json.dumps({"microbenchmark": results}))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+"""CLI: `python -m ray_tpu.scripts <command>` (or the `ray-tpu` entry point).
+
+Parity: python/ray/scripts/scripts.py — `ray start` (:537), `stop` (:1001),
+`status`, `list`, `microbenchmark`, plus job submission (`ray job submit`,
+dashboard/modules/job/cli.py). The head command starts GCS + a raylet and
+prints the address workers/drivers connect to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def cmd_start(args) -> int:
+    from ray_tpu.core.cluster_backend import (
+        ProcessGroup,
+        _session_tmp_dir,
+        start_gcs,
+        start_raylet,
+    )
+
+    session = args.session or f"cli{os.getpid()}"
+    procs = ProcessGroup(_session_tmp_dir(session))
+    if args.head:
+        gcs_address = start_gcs(procs)
+        print(f"GCS listening at {gcs_address}")
+        print(f"Connect drivers with ray_tpu.init(address='{gcs_address}') "
+              f"or workers with: ray-tpu start --address={gcs_address}")
+    else:
+        if not args.address:
+            print("--address required for non-head nodes", file=sys.stderr)
+            return 2
+        gcs_address = args.address
+    start_raylet(
+        procs, gcs_address, session,
+        node_id=args.node_id or f"cli-node-{os.getpid()}",
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+    )
+    print(f"raylet started (session={session}); Ctrl-C to stop")
+    addr_file = os.path.expanduser("~/.ray_tpu_cli.json")
+    with open(addr_file, "w") as f:
+        json.dump({"address": gcs_address, "session": session,
+                   "pids": [p.pid for p in procs.procs]}, f)
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            procs.shutdown()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    addr_file = os.path.expanduser("~/.ray_tpu_cli.json")
+    if not os.path.exists(addr_file):
+        print("no ray-tpu processes recorded")
+        return 0
+    with open(addr_file) as f:
+        info = json.load(f)
+    for pid in info.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped pid {pid}")
+        except ProcessLookupError:
+            pass
+    os.unlink(addr_file)
+    return 0
+
+
+def _connect(args):
+    import ray_tpu
+
+    address = args.address
+    if address is None:
+        addr_file = os.path.expanduser("~/.ray_tpu_cli.json")
+        if os.path.exists(addr_file):
+            with open(addr_file) as f:
+                address = json.load(f)["address"]
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    ray = _connect(args)
+    from ray_tpu.util import state
+
+    metrics = state.summarize_metrics()
+    print(json.dumps({
+        "cluster_resources": ray.cluster_resources(),
+        "available_resources": ray.available_resources(),
+        "metrics": metrics,
+    }, indent=2, default=str))
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state
+
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }.get(args.entity)
+    if fn is None:
+        print(f"unknown entity {args.entity}", file=sys.stderr)
+        return 2
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu.microbenchmark import main as bench_main
+
+    bench_main()
+    return 0
+
+
+def cmd_job_submit(args) -> int:
+    ray = _connect(args)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=" ".join(args.entrypoint),
+        runtime_env={"working_dir": args.working_dir} if args.working_dir else None,
+    )
+    print(f"job {job_id} submitted")
+    if args.wait:
+        status = client.wait_job(job_id)
+        print(f"job {job_id} finished: {status['status']}")
+        logs = client.get_job_logs(job_id)
+        if logs:
+            print(logs)
+        return 0 if status["status"] == "SUCCEEDED" else 1
+    return 0
+
+
+def cmd_job_status(args) -> int:
+    _connect(args)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    print(json.dumps(JobSubmissionClient().get_job_status(args.job_id),
+                     indent=2, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start node daemons")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address")
+    p.add_argument("--session")
+    p.add_argument("--node-id")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop daemons started by this CLI")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster resources + metrics")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("entity", choices=["nodes", "actors", "tasks", "objects",
+                                      "placement-groups"])
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("microbenchmark", help="core op/s microbenchmarks")
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    job = sub.add_parser("job", help="job submission")
+    jsub = job.add_subparsers(dest="job_command", required=True)
+    p = jsub.add_parser("submit")
+    p.add_argument("--address")
+    p.add_argument("--working-dir")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_job_submit)
+    p = jsub.add_parser("status")
+    p.add_argument("--address")
+    p.add_argument("job_id")
+    p.set_defaults(fn=cmd_job_status)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""State API: list/get cluster entities.
+
+Parity: python/ray/util/state/api.py:109 (`StateApiClient`, list_actors :782,
+list_tasks :1009, list_nodes, list_objects, list_placement_groups) — backed
+by the GCS (node/actor/PG tables, task-event log) and per-raylet object
+directories.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _gcs_call(method: str, **kwargs):
+    from ray_tpu.api import _auto_init, _global_worker
+
+    _auto_init()
+    backend = _global_worker().backend
+    core = getattr(backend, "core", None)
+    if core is None:  # local mode: synthesize from the backend
+        return backend.state_call(method, **kwargs)
+    return core.io.run(core.gcs.call(method, timeout=30, **kwargs))
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _gcs_call("get_nodes")
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    out = []
+    for a in _gcs_call("list_actors"):
+        a = dict(a)
+        if isinstance(a.get("actor_id"), bytes):
+            a["actor_id"] = a["actor_id"].hex()
+        out.append(a)
+    return out
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs_call("list_tasks", limit=limit)
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    out = []
+    for pg in _gcs_call("list_placement_groups"):
+        pg = dict(pg)
+        if isinstance(pg.get("pg_id"), bytes):
+            pg["pg_id"] = pg["pg_id"].hex()
+        out.append(pg)
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Per-node object-store stats (num objects, bytes, spilled)."""
+    from ray_tpu.api import _auto_init, _global_worker
+
+    _auto_init()
+    backend = _global_worker().backend
+    core = getattr(backend, "core", None)
+    if core is None:
+        return backend.state_call("object_stats")
+    nodes = list_nodes()
+    out = []
+    for n in nodes:
+        if not n.get("Alive"):
+            continue
+        try:
+            async def q(addr=n["NodeManagerAddress"]):
+                conn = await core._conn_to(addr, kind="raylet")
+                if conn is None:
+                    return None
+                return await conn.call("object_stats", timeout=10)
+
+            stats = core.io.run(q())
+            if stats is not None:
+                out.append({"node_id": n["NodeID"], **stats})
+        except Exception:  # noqa: BLE001 - node racing shutdown
+            pass
+    return out
+
+
+def summarize_metrics() -> Dict[str, Any]:
+    """Cluster-level counters (nodes, actors, task states)."""
+    return _gcs_call("get_metrics")

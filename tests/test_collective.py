@@ -1,0 +1,107 @@
+"""Collective group API tests (parity: util/collective tests).
+
+Host-plane collectives between actors: allreduce/broadcast/allgather/
+barrier/send-recv through the object store + a named rendezvous actor.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray4():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _worker_cls(ray):
+    @ray.remote(num_cpus=1)
+    class Rank:
+        def __init__(self, rank, world, group):
+            from ray_tpu.util import collective as col
+
+            self.col = col
+            self.group = col.init_collective_group(world, rank, group)
+            self.rank = rank
+
+        def allreduce(self, value):
+            return self.group.allreduce(np.asarray(value, np.float32))
+
+        def allgather(self, value):
+            return self.group.allgather(np.asarray(value))
+
+        def broadcast(self, value=None):
+            return self.group.broadcast(value, src_rank=0)
+
+        def reducescatter(self, value):
+            return self.group.reducescatter(np.asarray(value, np.float32))
+
+        def barrier_then(self, x):
+            self.group.barrier()
+            return x
+
+        def send_to(self, dst, value):
+            self.group.send(np.asarray(value), dst)
+            return True
+
+        def recv_from(self, src):
+            return self.group.recv(src)
+
+    return Rank
+
+
+def test_allreduce_and_allgather(ray4):
+    Rank = _worker_cls(ray4)
+    ranks = [Rank.remote(i, 3, "g1") for i in range(3)]
+    outs = ray4.get([r.allreduce.remote([1.0 * (i + 1)] * 4)
+                     for i, r in enumerate(ranks)], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, [6.0] * 4)
+    gathered = ray4.get([r.allgather.remote([i]) for i, r in enumerate(ranks)],
+                        timeout=60)
+    for g in gathered:
+        assert [int(x[0]) for x in g] == [0, 1, 2]
+    for r in ranks:
+        ray4.kill(r)
+
+
+def test_broadcast_and_barrier(ray4):
+    Rank = _worker_cls(ray4)
+    ranks = [Rank.remote(i, 2, "g2") for i in range(2)]
+    outs = ray4.get(
+        [ranks[0].broadcast.remote(np.arange(5)), ranks[1].broadcast.remote()],
+        timeout=60,
+    )
+    np.testing.assert_array_equal(outs[0], np.arange(5))
+    np.testing.assert_array_equal(outs[1], np.arange(5))
+    assert ray4.get([r.barrier_then.remote(i) for i, r in enumerate(ranks)],
+                    timeout=60) == [0, 1]
+    for r in ranks:
+        ray4.kill(r)
+
+
+def test_reducescatter_shards(ray4):
+    Rank = _worker_cls(ray4)
+    ranks = [Rank.remote(i, 2, "g3") for i in range(2)]
+    outs = ray4.get(
+        [r.reducescatter.remote(np.ones(6)) for r in ranks], timeout=60
+    )
+    np.testing.assert_allclose(outs[0], [2.0, 2.0, 2.0])
+    np.testing.assert_allclose(outs[1], [2.0, 2.0, 2.0])
+    for r in ranks:
+        ray4.kill(r)
+
+
+def test_send_recv(ray4):
+    Rank = _worker_cls(ray4)
+    ranks = [Rank.remote(i, 2, "g4") for i in range(2)]
+    send = ranks[0].send_to.remote(1, [7, 8, 9])
+    got = ray4.get(ranks[1].recv_from.remote(0), timeout=60)
+    assert ray4.get(send, timeout=60)
+    np.testing.assert_array_equal(got, [7, 8, 9])
+    for r in ranks:
+        ray4.kill(r)
