@@ -79,14 +79,49 @@ def daemon_env(keep_tpu: bool = False) -> dict:
     return env
 
 
+def _token_path(gcs_address: str) -> str:
+    safe = gcs_address.replace(":", "_").replace("/", "_")
+    return os.path.join("/tmp", "ray_tpu", f"token-{safe}")
+
+
+def load_cluster_token(gcs_address: str) -> None:
+    """Same-host drivers joining by address pick up the cluster token from
+    the file start_gcs wrote (cross-host joins must export RAY_TPU_TOKEN)."""
+    if rpc.get_auth_token() is not None:
+        return
+    try:
+        with open(_token_path(gcs_address)) as f:
+            rpc.set_auth_token(f.read().strip())
+    except OSError:
+        pass
+
+
 def start_gcs(pg: ProcessGroup, port: int = 0) -> str:
+    # A fresh cluster mints its session auth token here, before the first
+    # daemon spawns: set_auth_token exports RAY_TPU_TOKEN, and every daemon/
+    # worker inherits it through daemon_env (rpc.py handshake). It is also
+    # written 0600 to a per-address file so same-host drivers can join by
+    # address alone.
+    if rpc.get_auth_token() is None:
+        import secrets
+
+        rpc.set_auth_token(secrets.token_hex(16))
     port = port or _free_port()
+    address = f"127.0.0.1:{port}"
+    try:
+        path = _token_path(address)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(rpc.get_auth_token())
+    except OSError:
+        pass
     pg.spawn(
         "gcs",
         [sys.executable, "-m", "ray_tpu.core.gcs.server", "--port", str(port)],
         env=daemon_env(),
     )
-    return f"127.0.0.1:{port}"
+    return address
 
 
 def start_raylet(
@@ -185,6 +220,7 @@ class ClusterBackend(Backend):
             )
         else:
             gcs_address = address
+            load_cluster_token(gcs_address)
         # connect driver core worker; discover the local raylet via GCS
         self.core = CoreWorker(
             gcs_address, None, session, node_id, mode="driver"

@@ -44,8 +44,10 @@ class _MemoryStore:
     def _event(self, oid) -> asyncio.Event:
         ev = self._events.get(oid)
         if ev is None:
-            ev = asyncio.Event()
-            self._events[oid] = ev
+            # setdefault is GIL-atomic: user threads (put_value) and the io
+            # loop (wait_for) race get-or-create here, and two distinct
+            # Events for one oid would strand a no-timeout waiter forever
+            ev = self._events.setdefault(oid, asyncio.Event())
         return ev
 
     def put_value(self, oid: ObjectID, data: bytes):

@@ -13,8 +13,10 @@ subscription id).
 from __future__ import annotations
 
 import asyncio
+import hmac
 import itertools
 import logging
+import os
 import pickle
 import threading
 import traceback
@@ -24,6 +26,33 @@ logger = logging.getLogger(__name__)
 
 REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
 _MAX_FRAME = 1 << 34  # 16 GiB guard
+
+# --------------------------------------------------------------------------
+# Cluster auth: a per-session shared secret. Frames are pickled, so an
+# unauthenticated peer that can reach any daemon port gets arbitrary code
+# execution — the handshake is table stakes (advisor finding r1/r2). The
+# dialing side of a connection trusts the address it chose and sends the
+# token as its first frame; the accepting side dispatches nothing until a
+# valid token arrives. Set via RAY_TPU_TOKEN (cluster start generates one
+# and passes it to every daemon/worker through the environment).
+# --------------------------------------------------------------------------
+_AUTH_MAGIC = b"RAYTPU-AUTH1 "
+_auth_token: Optional[str] = os.environ.get("RAY_TPU_TOKEN") or None
+
+
+def set_auth_token(token: Optional[str]) -> None:
+    global _auth_token
+    _auth_token = token or None
+    if token:
+        os.environ["RAY_TPU_TOKEN"] = token
+
+
+def get_auth_token() -> Optional[str]:
+    return _auth_token
+
+
+def _auth_frame_payload() -> bytes:
+    return _AUTH_MAGIC + (_auth_token or "").encode()
 
 
 class RpcError(Exception):
@@ -59,12 +88,17 @@ def _frame(obj) -> bytes:
 class Connection:
     """One bidirectional connection: concurrent requests + pushes both ways."""
 
-    def __init__(self, reader, writer, handler=None, on_close=None, name=""):
+    def __init__(self, reader, writer, handler=None, on_close=None, name="",
+                 trusted: bool = True):
         self.reader = reader
         self.writer = writer
         self.handler = handler  # object with async handle_<method>(**payload)
         self.on_close = on_close
         self.name = name
+        # inbound trust: dialed-out connections trust their chosen peer;
+        # accepted connections read a first-frame auth preamble (and require
+        # the session token when one is configured)
+        self._accepted = not trusted
         self._next_id = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._push_handlers: Dict[str, Callable] = {}
@@ -151,37 +185,74 @@ class Connection:
 
     async def _read_loop(self):
         try:
+            if self._accepted:
+                if not await self._accept_first_frame():
+                    return  # finally: close
             while True:
                 msg_type, msg_id, method, payload = await _read_frame(self.reader)
-                if msg_type == REQUEST:
-                    self._spawn(self._dispatch(msg_id, method, payload))
-                elif msg_type == RESPONSE:
-                    fut = self._pending.get(msg_id)
-                    if fut and not fut.done():
-                        fut.set_result(payload)
-                elif msg_type == ERROR:
-                    fut = self._pending.get(msg_id)
-                    if fut and not fut.done():
-                        fut.set_exception(
-                            RemoteCallError(method, payload["cls"], payload["tb"])
-                        )
-                elif msg_type == PUSH:
-                    fn = self._push_handlers.get(method)
-                    if fn:
-                        res = fn(payload)
-                        if asyncio.iscoroutine(res):
-                            self._spawn(res)
+                self._process(msg_type, msg_id, method, payload)
         except (
             asyncio.IncompleteReadError,
             ConnectionResetError,
             BrokenPipeError,
             asyncio.CancelledError,
+            asyncio.TimeoutError,
         ):
             pass
         except Exception:  # noqa: BLE001
             logger.exception("rpc read loop error on %s", self.name)
         finally:
             await self._handle_close()
+
+    async def _accept_first_frame(self) -> bool:
+        """Server side of the auth handshake. The first frame from a dialing
+        peer is read RAW and checked for the auth preamble before anything is
+        unpickled — unpickling attacker bytes IS the code-exec vector the
+        handshake exists to close. Timeout-bounded so an idle unauthenticated
+        socket can't hold a server slot forever. Returns False to reject."""
+        header = await asyncio.wait_for(self.reader.readexactly(8), timeout=15)
+        n = int.from_bytes(header, "little")
+        if n <= 0 or n > _MAX_FRAME:
+            return False
+        data = await asyncio.wait_for(self.reader.readexactly(n), timeout=60)
+        if data.startswith(_AUTH_MAGIC):
+            if _auth_token is not None and not hmac.compare_digest(
+                    data, _auth_frame_payload()):
+                logger.warning(
+                    "bad auth token on %s from %s; closing",
+                    self.name, self.peername,
+                )
+                return False
+            return True  # preamble consumed (token-less servers accept any)
+        if _auth_token is not None:
+            logger.warning(
+                "unauthenticated connection on %s from %s; closing",
+                self.name, self.peername,
+            )
+            return False
+        # no token configured and no preamble sent: a plain first frame
+        self._process(*pickle.loads(data))
+        return True
+
+    def _process(self, msg_type, msg_id, method, payload):
+        if msg_type == REQUEST:
+            self._spawn(self._dispatch(msg_id, method, payload))
+        elif msg_type == RESPONSE:
+            fut = self._pending.get(msg_id)
+            if fut and not fut.done():
+                fut.set_result(payload)
+        elif msg_type == ERROR:
+            fut = self._pending.get(msg_id)
+            if fut and not fut.done():
+                fut.set_exception(
+                    RemoteCallError(method, payload["cls"], payload["tb"])
+                )
+        elif msg_type == PUSH:
+            fn = self._push_handlers.get(method)
+            if fn:
+                res = fn(payload)
+                if asyncio.iscoroutine(res):
+                    self._spawn(res)
 
     async def _dispatch(self, msg_id, method, payload):
         try:
@@ -262,6 +333,7 @@ class RpcServer:
             handler=self.handler,
             on_close=self._on_conn_close,
             name=f"server<-{writer.get_extra_info('peername')}",
+            trusted=False,
         ).start()
         self.connections.add(conn)
         cb = getattr(self.handler, "on_connection", None)
@@ -297,6 +369,12 @@ async def connect(
     for _ in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, int(port_s))
+            # always send the preamble (empty token when none configured):
+            # uniform first frame regardless of auth config, so mismatches
+            # fail at the auth gate with a clear log, not as UnpicklingError
+            payload = _auth_frame_payload()
+            writer.write(len(payload).to_bytes(8, "little") + payload)
+            await writer.drain()
             return Connection(reader, writer, handler=handler, name=name).start()
         except (ConnectionRefusedError, OSError) as e:
             last_err = e

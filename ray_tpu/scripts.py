@@ -20,6 +20,7 @@ def cmd_start(args) -> int:
     from ray_tpu.core.cluster_backend import (
         ProcessGroup,
         _session_tmp_dir,
+        load_cluster_token,
         start_gcs,
         start_raylet,
     )
@@ -36,6 +37,7 @@ def cmd_start(args) -> int:
             print("--address required for non-head nodes", file=sys.stderr)
             return 2
         gcs_address = args.address
+        load_cluster_token(gcs_address)  # same-host join; else RAY_TPU_TOKEN
     start_raylet(
         procs, gcs_address, session,
         node_id=args.node_id or f"cli-node-{os.getpid()}",

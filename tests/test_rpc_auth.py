@@ -1,0 +1,103 @@
+"""RPC auth handshake: unauthenticated peers must be rejected BEFORE any
+frame is unpickled (pickle deserialization is the code-exec vector).
+Advisor finding r1/r2; parity motivation: the reference runs gRPC inside a
+trusted perimeter, our pickled frames must not assume one.
+"""
+
+import pickle
+import socket
+import struct
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _gcs_hostport(ray):
+    from ray_tpu.api import _global_worker
+
+    addr = _global_worker().backend.core.gcs_address
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def _frame(obj) -> bytes:
+    data = pickle.dumps(obj, protocol=5)
+    return struct.pack("<Q", len(data)) + data
+
+
+def test_cluster_has_token(cluster):
+    from ray_tpu.core import rpc
+
+    assert rpc.get_auth_token(), "fresh cluster must mint a session token"
+
+
+def test_unauthenticated_peer_rejected(cluster):
+    host, port = _gcs_hostport(cluster)
+    s = socket.create_connection((host, port), timeout=5)
+    s.settimeout(5)
+    # a well-formed RPC frame without the auth preamble
+    s.sendall(_frame((0, 1, "get_nodes", {})))
+    # server must close without ever responding
+    assert s.recv(4096) == b"", "server must drop unauthenticated peers"
+    s.close()
+
+
+def test_wrong_token_rejected(cluster):
+    host, port = _gcs_hostport(cluster)
+    s = socket.create_connection((host, port), timeout=5)
+    s.settimeout(5)
+    bad = b"RAYTPU-AUTH1 " + b"f" * 32
+    s.sendall(struct.pack("<Q", len(bad)) + bad)
+    s.sendall(_frame((0, 1, "get_nodes", {})))
+    assert s.recv(4096) == b"", "server must drop wrong-token peers"
+    s.close()
+
+
+def test_correct_token_accepted(cluster):
+    from ray_tpu.core import rpc
+
+    host, port = _gcs_hostport(cluster)
+    s = socket.create_connection((host, port), timeout=10)
+    s.settimeout(10)
+    good = b"RAYTPU-AUTH1 " + rpc.get_auth_token().encode()
+    s.sendall(struct.pack("<Q", len(good)) + good)
+    s.sendall(_frame((0, 1, "get_nodes", {})))
+    hdr = s.recv(8)
+    assert len(hdr) == 8, "authed peer must get a response"
+    s.close()
+
+
+def test_cross_process_driver_joins_via_token_file(cluster):
+    """A second driver process with a clean environment joins by address
+    alone: the token file written by start_gcs must authenticate it."""
+    import os
+    import subprocess
+    import sys
+
+    from ray_tpu.api import _global_worker
+
+    addr = _global_worker().backend.core.gcs_address
+    env = {k: v for k, v in os.environ.items() if k != "RAY_TPU_TOKEN"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    code = (
+        "import ray_tpu\n"
+        f"ray_tpu.init(address='{addr}')\n"
+        "@ray_tpu.remote\n"
+        "def f(): return 41\n"
+        "print('JOINED', ray_tpu.get(f.remote(), timeout=60) + 1)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert "JOINED 42" in out.stdout, out.stdout + out.stderr
