@@ -8,13 +8,19 @@ reference structure this mirrors.
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env_runner import EnvRunner
-from ray_tpu.rllib.learner import JaxLearner, LearnerGroup, PPOLearner
+from ray_tpu.rllib.learner import (
+    IMPALALearner,
+    JaxLearner,
+    LearnerGroup,
+    PPOLearner,
+)
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "EnvRunner",
+    "IMPALALearner",
     "JaxLearner",
     "LearnerGroup",
     "PPOLearner",

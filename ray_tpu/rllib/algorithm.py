@@ -137,6 +137,8 @@ class Algorithm(Trainable):
             gamma=cfg.gamma,
             lambda_=cfg.lambda_,
             seed=cfg.seed,
+            # algorithm-specific runner knobs (e.g. IMPALA's vtrace batches)
+            **self._runner_kwargs_extra(),
         )
         if cfg.num_rollout_workers > 0:
             import ray_tpu
@@ -156,6 +158,10 @@ class Algorithm(Trainable):
 
     def _make_learner_group(self):
         raise NotImplementedError
+
+    def _runner_kwargs_extra(self) -> Dict[str, Any]:
+        """Subclass hook: extra EnvRunner kwargs (e.g. postprocess mode)."""
+        return {}
 
     # -- rollout helpers ---------------------------------------------------- #
     def _steps_per_round(self) -> int:

@@ -1,4 +1,11 @@
-from ray_tpu.rllib.env.vector_env import VectorEnv, make_vector_env
 from ray_tpu.rllib.env.cartpole import CartPoleVectorEnv
+from ray_tpu.rllib.env.pong import PongVectorEnv
+from ray_tpu.rllib.env.vector_env import VectorEnv, make_vector_env, register_env
 
-__all__ = ["VectorEnv", "make_vector_env", "CartPoleVectorEnv"]
+__all__ = [
+    "VectorEnv",
+    "make_vector_env",
+    "register_env",
+    "CartPoleVectorEnv",
+    "PongVectorEnv",
+]

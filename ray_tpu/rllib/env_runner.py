@@ -38,6 +38,7 @@ class EnvRunner:
         lambda_: float = 0.95,
         seed: int = 0,
         worker_index: int = 0,
+        postprocess: str = "gae",
     ):
         import jax
 
@@ -45,6 +46,11 @@ class EnvRunner:
         self.gamma = gamma
         self.lambda_ = lambda_
         self.worker_index = worker_index
+        # "gae": flat [T*N] rows with advantages attached (PPO and friends).
+        # "vtrace": time-major [T, N] rows + behavior logp + bootstrap obs —
+        # the learner computes advantages itself (IMPALA; the actor's value
+        # head is stale by design there).
+        self.postprocess = postprocess
         self._rng_key = jax.random.PRNGKey(seed * 10_007 + worker_index)
         self.params = mlp_actor_critic_init(
             self._rng_key, self.env.obs_dim, self.env.num_actions, hiddens
@@ -142,6 +148,24 @@ class EnvRunner:
                 self._ep_len[done] = 0
         self._obs = obs
 
+        metrics = {
+            "episode_returns": list(self._episode_returns),
+            "episode_lengths": list(self._episode_lengths),
+            "num_env_steps": T * N,
+            "worker_index": self.worker_index,
+        }
+        if self.postprocess == "vtrace":
+            batch = SampleBatch({
+                SampleBatch.OBS: obs_buf,              # [T, N, D]
+                SampleBatch.ACTIONS: act_buf,          # [T, N]
+                SampleBatch.REWARDS: rew_buf,
+                SampleBatch.TERMINATEDS: term_buf,
+                SampleBatch.TRUNCATEDS: trunc_buf,
+                SampleBatch.ACTION_LOGP: logp_buf,     # behavior policy
+                "_bootstrap_obs": np.asarray(obs, np.float32),  # [N, D]
+            })
+            return batch, metrics
+
         bootstrap = np.asarray(self._value(self.params, obs))
         advantages, value_targets = compute_gae_lanes(
             rew_buf, vf_buf, bootstrap, term_buf, trunc_buf,
@@ -163,10 +187,4 @@ class EnvRunner:
             SampleBatch.VALUE_TARGETS: flat(value_targets),
             SampleBatch.EPS_ID: flat(eps_buf),
         })
-        metrics = {
-            "episode_returns": list(self._episode_returns),
-            "episode_lengths": list(self._episode_lengths),
-            "num_env_steps": T * N,
-            "worker_index": self.worker_index,
-        }
         return batch, metrics

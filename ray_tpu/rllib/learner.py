@@ -230,6 +230,134 @@ class PPOLearner(JaxLearner):
         return total, aux
 
 
+class IMPALALearner(JaxLearner):
+    """V-trace actor-critic loss (IMPALA, Espeholt et al. 2018).
+
+    Parity: rllib/algorithms/impala/torch/impala_torch_learner.py — policy
+    gradient with clipped importance weights, baseline loss against v-trace
+    targets, entropy bonus. One pass over the whole time-major batch per
+    update (no epochs/minibatches): the single jitted step keeps the learner
+    hot while async actors stream batches at it.
+    """
+
+    def __init__(
+        self,
+        *args,
+        gamma: float = 0.99,
+        vf_loss_coeff: float = 0.5,
+        entropy_coeff: float = 0.01,
+        clip_rho_threshold: float = 1.0,
+        clip_c_threshold: float = 1.0,
+        **kwargs,
+    ):
+        self.gamma = gamma
+        self.vf_loss_coeff = vf_loss_coeff
+        self.entropy_coeff = entropy_coeff
+        self.clip_rho_threshold = clip_rho_threshold
+        self.clip_c_threshold = clip_c_threshold
+        self._impala_update = None
+        super().__init__(*args, **kwargs)
+
+    def _build_impala_update(self):
+        import jax
+        import optax
+
+        optimizer = self._optimizer
+        loss_fn = self.loss_fn
+
+        def update(state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state["params"], batch
+            )
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            new_params = optax.apply_updates(state["params"], updates)
+            new_state = {
+                "params": new_params,
+                "opt_state": new_opt,
+                "step": state["step"] + 1,
+            }
+            aux = dict(aux, total_loss=loss, grad_norm=optax.global_norm(grads))
+            return new_state, aux
+
+        return jax.jit(update, donate_argnums=(0,))
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        if self._impala_update is None:
+            self._impala_update = self._build_impala_update()
+        arrays = self._prepare_batch(batch)
+        T, N = arrays["rewards"].shape
+        self._state, metrics = self._impala_update(self._state, arrays)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["num_env_steps_trained"] = T * N
+        return out
+
+    def _prepare_batch(self, batch: SampleBatch):
+        import jax.numpy as jnp
+
+        done = np.asarray(
+            batch[SampleBatch.TERMINATEDS] | batch[SampleBatch.TRUNCATEDS]
+        )
+        return {
+            "obs": jnp.asarray(batch[SampleBatch.OBS], jnp.float32),      # [T,N,D]
+            "actions": jnp.asarray(batch[SampleBatch.ACTIONS]),           # [T,N]
+            "behavior_logp": jnp.asarray(
+                batch[SampleBatch.ACTION_LOGP], jnp.float32
+            ),
+            "rewards": jnp.asarray(batch[SampleBatch.REWARDS], jnp.float32),
+            "discounts": jnp.asarray(
+                self.gamma * (1.0 - done.astype(np.float32)), jnp.float32
+            ),
+            "bootstrap_obs": jnp.asarray(batch["_bootstrap_obs"], jnp.float32),
+        }
+
+    def loss_fn(self, params, mb):
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.models import (
+            categorical_entropy,
+            categorical_logp,
+            mlp_actor_critic_apply,
+        )
+        from ray_tpu.rllib.vtrace import vtrace_from_logps
+
+        T, N, D = mb["obs"].shape
+        logits, values = mlp_actor_critic_apply(
+            params, mb["obs"].reshape(T * N, D)
+        )
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+        target_logp = categorical_logp(logits, mb["actions"])
+        bootstrap_value = mlp_actor_critic_apply(params, mb["bootstrap_obs"])[1]
+
+        vt = vtrace_from_logps(
+            behavior_logp=mb["behavior_logp"],
+            target_logp=target_logp,
+            rewards=mb["rewards"],
+            values=values,
+            bootstrap_value=bootstrap_value,
+            discounts=mb["discounts"],
+            clip_rho_threshold=self.clip_rho_threshold,
+            clip_c_threshold=self.clip_c_threshold,
+        )
+        pg_loss = -jnp.mean(vt.pg_advantages * target_logp)
+        vf_loss = 0.5 * jnp.mean((vt.vs - values) ** 2)
+        entropy = jnp.mean(categorical_entropy(logits))
+        total = (
+            pg_loss + self.vf_loss_coeff * vf_loss - self.entropy_coeff * entropy
+        )
+        aux = {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.mean(
+                jnp.exp(target_logp - mb["behavior_logp"])
+            ),
+        }
+        return total, aux
+
+
 class LearnerGroup:
     """Runs a learner in-process or as one remote accelerator-owning actor.
 

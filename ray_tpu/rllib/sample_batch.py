@@ -15,7 +15,12 @@ import numpy as np
 
 
 class SampleBatch(dict):
-    """A dict of equally-long numpy columns. Length = first dim of any column."""
+    """A dict of equally-long numpy columns. Length = first dim of any column.
+
+    Keys starting with "_" are per-batch metadata (e.g. "_bootstrap_obs" for
+    v-trace batches): exempt from the equal-length rule, carried through
+    slice/take untouched, and excluded from row counting.
+    """
 
     OBS = "obs"
     NEXT_OBS = "next_obs"
@@ -34,13 +39,17 @@ class SampleBatch(dict):
         for k, v in list(self.items()):
             if not isinstance(v, np.ndarray):
                 self[k] = np.asarray(v)
-        lens = {len(v) for v in self.values()}
+        lens = {len(v) for k, v in self.items() if not k.startswith("_")}
         if len(lens) > 1:
-            raise ValueError(f"ragged SampleBatch columns: { {k: len(v) for k, v in self.items()} }")
+            raise ValueError(
+                f"ragged SampleBatch columns: "
+                f"{ {k: len(v) for k, v in self.items()} }"
+            )
 
     def __len__(self) -> int:
-        for v in self.values():
-            return len(v)
+        for k, v in self.items():
+            if not k.startswith("_"):
+                return len(v)
         return 0
 
     @property
@@ -48,10 +57,16 @@ class SampleBatch(dict):
         return len(self)
 
     def slice(self, start: int, end: int) -> "SampleBatch":
-        return SampleBatch({k: v[start:end] for k, v in self.items()})
+        return SampleBatch({
+            k: (v if k.startswith("_") else v[start:end])
+            for k, v in self.items()
+        })
 
     def take(self, indices: np.ndarray) -> "SampleBatch":
-        return SampleBatch({k: v[indices] for k, v in self.items()})
+        return SampleBatch({
+            k: (v if k.startswith("_") else v[indices])
+            for k, v in self.items()
+        })
 
     def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
         perm = rng.permutation(len(self))
@@ -68,9 +83,16 @@ class SampleBatch(dict):
         if not batches:
             return SampleBatch()
         keys = batches[0].keys()
-        return SampleBatch(
-            {k: np.concatenate([b[k] for b in batches], axis=0) for k in keys}
-        )
+        # metadata ("_"-prefixed) is per-batch, not per-row: concatenating
+        # it would corrupt e.g. _bootstrap_obs ([N,D] + [N,D] -> [2N,D]
+        # against [2T,N] rows); keep the last batch's copy instead
+        return SampleBatch({
+            k: (
+                batches[-1][k] if k.startswith("_")
+                else np.concatenate([b[k] for b in batches], axis=0)
+            )
+            for k in keys
+        })
 
     def split_by_episode(self) -> List["SampleBatch"]:
         """Split on EPS_ID boundaries (rows must be grouped by episode)."""

@@ -1,0 +1,74 @@
+"""V-trace off-policy actor-critic targets (IMPALA, Espeholt et al. 2018).
+
+Parity: rllib/algorithms/impala/vtrace_torch.py (from_importance_weights) —
+the correction that lets a learner train on trajectories sampled by actors
+holding stale weights. TPU-native: a single `lax.scan` over the time axis
+(time-major [T, N] arrays), jit/grad-safe, no Python loops.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class VTraceReturns(NamedTuple):
+    vs: "jax.Array"             # [T, N] v-trace value targets
+    pg_advantages: "jax.Array"  # [T, N] policy-gradient advantages
+
+
+def vtrace_from_logps(
+    behavior_logp,
+    target_logp,
+    rewards,
+    values,
+    bootstrap_value,
+    discounts,
+    clip_rho_threshold: float = 1.0,
+    clip_c_threshold: float = 1.0,
+) -> VTraceReturns:
+    """All inputs time-major.
+
+    behavior_logp/target_logp: [T, N] log pi_b(a|s) / log pi(a|s)
+    rewards:                   [T, N]
+    values:                    [T, N] learner's V(s_t)
+    bootstrap_value:           [N]    learner's V(s_{T}) for the next obs
+    discounts:                 [T, N] gamma * (1 - done_t)
+
+    Returns targets with gradients stopped — pass them to the loss as
+    constants (reference semantics: vtrace targets are leaves).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
+    cs = jnp.minimum(rhos, clip_c_threshold)
+
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0
+    )
+    deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
+
+    # vs_minus_v[t] = delta[t] + discount[t] * c[t] * vs_minus_v[t+1]
+    def body(carry, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * carry
+        return acc, acc
+
+    _, rev = lax.scan(
+        body,
+        jnp.zeros_like(bootstrap_value),
+        (deltas[::-1], discounts[::-1], cs[::-1]),
+    )
+    vs_minus_v = rev[::-1]
+    vs = values + vs_minus_v
+
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (
+        rewards + discounts * vs_t_plus_1 - values
+    )
+    return VTraceReturns(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg_advantages),
+    )
