@@ -30,8 +30,10 @@ class Cluster:
         self.gcs_address: Optional[str] = None
         self.node_ids: List[str] = []
         self._raylet_procs: Dict[str, subprocess.Popen] = {}
+        self._gcs_proc: Optional[subprocess.Popen] = None
         if initialize_head:
             self.gcs_address = start_gcs(self.procs)
+            self._gcs_proc = self.procs.procs[0]
             self.add_node(**(head_node_args or {}))
 
     @property
@@ -64,6 +66,28 @@ class Cluster:
         p = self._raylet_procs.get(node_id)
         if p is not None:
             p.kill()
+
+    def kill_gcs(self):
+        """SIGKILL the GCS process (fault-tolerance chaos testing)."""
+        p = self._gcs_proc or self.procs.procs[0]  # start_gcs spawns first
+        p.kill()
+        p.wait(timeout=10)
+
+    def restart_gcs(self):
+        """Restart the GCS on the SAME port with the same snapshot store;
+        raylets/drivers re-register through their reconnect loops."""
+        import sys
+
+        from ray_tpu.core.cluster_backend import daemon_env
+
+        port = self.gcs_address.rsplit(":", 1)[1]
+        store = os.path.join(self.procs.session_dir, "gcs_store.pkl")
+        self._gcs_proc = self.procs.spawn(
+            "gcs-restarted",
+            [sys.executable, "-m", "ray_tpu.core.gcs.server",
+             "--port", port, "--store", store],
+            env=daemon_env(),
+        )
 
     def wait_for_nodes(self, n: Optional[int] = None, timeout: float = 30.0):
         import ray_tpu

@@ -116,9 +116,13 @@ def start_gcs(pg: ProcessGroup, port: int = 0) -> str:
             f.write(rpc.get_auth_token())
     except OSError:
         pass
+    # fault tolerance: durable tables snapshot next to the session logs, so
+    # a restarted GCS on this address recovers KV/functions/detached actors
+    store = os.path.join(pg.session_dir, "gcs_store.pkl")
     pg.spawn(
         "gcs",
-        [sys.executable, "-m", "ray_tpu.core.gcs.server", "--port", str(port)],
+        [sys.executable, "-m", "ray_tpu.core.gcs.server",
+         "--port", str(port), "--store", store],
         env=daemon_env(),
     )
     return address

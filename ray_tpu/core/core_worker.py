@@ -125,6 +125,8 @@ class CoreWorker:
         self._task_events_lock = threading.Lock()
         self._fn_cache: Dict[bytes, Any] = {}
         self._registered_fns: set = set()
+        self._registered_blobs: Dict[bytes, bytes] = {}
+        self._packed_envs: Dict[str, dict] = {}
         self._actor_addr_cache: Dict[bytes, str] = {}
         self._actor_queues: Dict[bytes, "_ActorSubmitState"] = {}
         self._actor_conns: Dict[str, rpc.Connection] = {}
@@ -162,6 +164,35 @@ class CoreWorker:
         if self.mode == "driver":
             await self.gcs.call("register_driver")
         asyncio.ensure_future(self._flush_task_events_loop())
+        asyncio.ensure_future(self._gcs_watchdog())
+
+    async def _gcs_watchdog(self):
+        """Re-dial the GCS if it restarts (fault tolerance: the store-backed
+        GCS comes back on the same address and we re-register)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if self.gcs is None or not self.gcs.closed:
+                continue
+            try:
+                self.gcs = await rpc.connect(
+                    self.gcs_address, handler=self,
+                    name=f"{self.mode}->gcs", retries=5, retry_delay=0.5,
+                )
+                if self.mode == "driver":
+                    await self.gcs.call("register_driver")
+                # functions registered <1s before the crash may have missed
+                # the snapshot: re-register everything we know from cache so
+                # outstanding fn_ids stay resolvable
+                for fn_id, blob in list(self._registered_blobs.items()):
+                    try:
+                        await self.gcs.call(
+                            "register_function", fn_id=fn_id, blob=blob
+                        )
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        break
+                logger.warning("reconnected to GCS at %s", self.gcs_address)
+            except rpc.ConnectionLost:
+                pass
 
     def shutdown(self):
         from ray_tpu.core import refs as refs_mod
@@ -443,15 +474,63 @@ class CoreWorker:
         blob = _pickle_callable(fn)
         fn_id = ts.function_id(blob)
         if fn_id not in self._registered_fns:
-            self.io.run(self.gcs.call("register_function", fn_id=fn_id, blob=blob))
+            self.io.run(
+                self._gcs_call_retrying(
+                    "register_function", fn_id=fn_id, blob=blob
+                )
+            )
             self._registered_fns.add(fn_id)
+            self._registered_blobs[fn_id] = blob
             self._fn_cache[fn_id] = fn
         return fn_id
+
+    async def _gcs_call_retrying(self, method, attempts: int = 10, **kw):
+        """GCS call that rides out a fault-tolerance restart window (the
+        watchdog re-dials within ~1s)."""
+        last: Optional[BaseException] = None
+        for _ in range(attempts):
+            try:
+                return await self.gcs.call(method, **kw)
+            except rpc.ConnectionLost as e:
+                last = e
+                await asyncio.sleep(0.5)
+        raise last
+
+    def _pack_runtime_env(self, options: RemoteOptions) -> Optional[dict]:
+        """Zip+upload runtime_env packages once per env (content-addressed
+        in the GCS KV) and return the wire dict for the spec."""
+        env = options.runtime_env
+        if not env:
+            return None
+        from ray_tpu import runtime_env as re_mod
+
+        # cache key includes a cheap dir fingerprint (count+size+mtime), so
+        # editing working_dir between submissions re-uploads instead of
+        # silently serving the first zip for the driver's lifetime
+        key = repr(sorted(env.items())) + re_mod.dirs_fingerprint(env)
+        wire = self._packed_envs.get(key)
+        if wire is None:
+            def kv_put(ns, k, v):
+                self.io.run(
+                    self._gcs_call_retrying("kv_put", ns=ns, key=k, value=v)
+                )
+
+            wire = re_mod.pack(env, kv_put)
+            self._packed_envs[key] = wire
+        return wire
 
     async def load_function(self, fn_id: bytes):
         fn = self._fn_cache.get(fn_id)
         if fn is None:
-            blob = await self.gcs.call("get_function", fn_id=fn_id)
+            blob = None
+            for attempt in range(10):
+                try:
+                    blob = await self.gcs.call("get_function", fn_id=fn_id)
+                    break
+                except rpc.ConnectionLost:
+                    # GCS restarting (fault tolerance): the watchdog re-dials
+                    # within ~1s — a task landing in that window must not fail
+                    await asyncio.sleep(0.5)
             if blob is None:
                 raise exc.RayTpuError(f"function {fn_id.hex()} not in registry")
             fn = cloudpickle.loads(blob)
@@ -481,6 +560,7 @@ class CoreWorker:
             scheduling_strategy=options.scheduling_strategy,
             placement_group_id=pg_id,
             placement_group_bundle_index=pg_index,
+            runtime_env=self._pack_runtime_env(options),
         )
         self.submitted_specs[task_id] = spec
         refs = spec.return_refs()
@@ -919,8 +999,13 @@ class CoreWorker:
         blob = _pickle_callable(cls)
         fn_id = ts.function_id(blob)
         if fn_id not in self._registered_fns:
-            self.io.run(self.gcs.call("register_function", fn_id=fn_id, blob=blob))
+            self.io.run(
+                self._gcs_call_retrying(
+                    "register_function", fn_id=fn_id, blob=blob
+                )
+            )
             self._registered_fns.add(fn_id)
+            self._registered_blobs[fn_id] = blob
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, self.put)
         spec = ts.TaskSpec(
             task_id=TaskID.from_random(),
@@ -934,9 +1019,10 @@ class CoreWorker:
             actor_id=actor_id,
             is_actor_creation=True,
             actor_options={"max_concurrency": options.max_concurrency},
+            runtime_env=self._pack_runtime_env(options),
         )
         reply = self.io.run(
-            self.gcs.call(
+            self._gcs_call_retrying(
                 "create_actor",
                 actor_id=actor_id.binary(),
                 spec_blob=cloudpickle.dumps(spec),

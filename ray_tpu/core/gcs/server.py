@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import pickle
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -108,8 +110,13 @@ class PlacementGroupInfo:
 
 
 class GcsServer:
-    def __init__(self, host="127.0.0.1", port=0):
+    def __init__(self, host="127.0.0.1", port=0, store_path: Optional[str] = None):
         self.server = rpc.RpcServer(self, host=host, port=port)
+        # fault tolerance: durable tables snapshot to store_path (the
+        # Redis-backed store_client of the reference, file-backed here);
+        # a restarted GCS on the same address restores them and nodes/
+        # drivers re-register over their reconnect loops
+        self.store_path = store_path
         self.nodes: Dict[str, NodeInfo] = {}
         self.kv: Dict[Tuple[str, str], bytes] = {}
         self.functions: Dict[bytes, bytes] = {}
@@ -127,18 +134,141 @@ class GcsServer:
 
         self.task_events: "deque" = deque(maxlen=10_000)
         self.metrics: Dict[str, int] = {}
+        self._store_dirty = True  # durable-table mutation since last snapshot
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
+        if self.store_path:
+            self._restore_store()
         await self.server.start()
         self._bg.append(asyncio.create_task(self._health_check_loop()))
+        if self.store_path:
+            self._bg.append(asyncio.create_task(self._snapshot_loop()))
         logger.info("GCS listening on %s", self.server.address)
         return self.server.address
 
     async def close(self):
         for t in self._bg:
             t.cancel()
+        if self.store_path:
+            self._write_snapshot()
         await self.server.close()
+
+    # --------------------------------------------------- fault tolerance
+    def _durable_state(self) -> dict:
+        """Tables that must survive a GCS restart. Nodes/connections are NOT
+        persisted: raylets and drivers re-register through their reconnect
+        loops. Detached actors/PGs are restored PENDING and reschedule as
+        nodes come back (parity: gcs/store_client tables)."""
+        detached_actors = {
+            aid: {
+                "spec_blob": i.spec_blob,
+                "name": i.name,
+                "namespace": i.namespace,
+                "max_restarts": i.max_restarts,
+                "restarts_left": i.restarts_left,
+                "resources": i.resources,
+                "pg_id": i.pg_id,
+                "bundle_index": i.bundle_index,
+                # adoption hint: reschedule on the node whose live worker
+                # still runs this actor, never a duplicate elsewhere
+                "node_id": i.node_id,
+            }
+            for aid, i in self.actors.items()
+            if i.detached and i.state != DEAD
+        }
+        detached_pgs = {
+            pg_id: {
+                "bundles": p.bundles,
+                "strategy": p.strategy,
+                # re-adopt the exact bundle placement: the raylets still hold
+                # these reservations (reserve_bundle is idempotent)
+                "placement": p.placement,
+            }
+            for pg_id, p in self.placement_groups.items()
+            if p.detached
+        }
+        return {
+            "kv": dict(self.kv),
+            "functions": dict(self.functions),
+            "job_counter": self.job_counter,
+            "actors": detached_actors,
+            "named_actors": {
+                k: v for k, v in self.named_actors.items()
+                if v in detached_actors
+            },
+            "placement_groups": detached_pgs,
+        }
+
+    def _write_snapshot(self) -> None:
+        try:
+            tmp = self.store_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(self._durable_state(), f)
+            os.replace(tmp, self.store_path)
+        except OSError:
+            logger.exception("GCS snapshot write failed")
+
+    async def _snapshot_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if not self._store_dirty:
+                continue
+            self._store_dirty = False
+            # the dump can carry large runtime_env packages in the KV:
+            # off-loop so scheduling/heartbeat RPCs never stall behind it
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._write_snapshot
+            )
+
+    def _restore_store(self) -> None:
+        try:
+            with open(self.store_path, "rb") as f:
+                state = pickle.load(f)
+        except FileNotFoundError:
+            return
+        except Exception:  # noqa: BLE001 - corrupt snapshot: start fresh
+            logger.exception("GCS snapshot restore failed; starting fresh")
+            return
+        self.kv = state.get("kv", {})
+        self.functions = state.get("functions", {})
+        self.job_counter = state.get("job_counter", 0)
+        for pg_id, p in state.get("placement_groups", {}).items():
+            self.placement_groups[pg_id] = PlacementGroupInfo(
+                pg_id=pg_id, bundles=p["bundles"], strategy=p["strategy"],
+                detached=True, placement=p.get("placement"),
+                state="CREATED" if p.get("placement") else "PENDING",
+            )
+        for aid, a in state.get("actors", {}).items():
+            info = ActorInfo(
+                actor_id=aid,
+                spec_blob=a["spec_blob"],
+                name=a["name"],
+                namespace=a["namespace"],
+                detached=True,
+                max_restarts=a["max_restarts"],
+                restarts_left=a["restarts_left"],
+                resources=a["resources"],
+                pg_id=a["pg_id"],
+                bundle_index=a["bundle_index"],
+            )
+            info.restore_node_hint = a.get("node_id")
+            self.actors[aid] = info
+        self.named_actors = dict(state.get("named_actors", {}))
+        n = len(self.actors)
+        logger.info(
+            "GCS restored: %d kv, %d fns, %d detached actors",
+            len(self.kv), len(self.functions), n,
+        )
+        # restored actors/PGs reschedule once nodes re-register
+        for info in list(self.actors.values()):
+            asyncio.get_event_loop().call_later(
+                1.0, lambda i=info: asyncio.ensure_future(self._retry_schedule(i))
+            )
+        for pg in list(self.placement_groups.values()):
+            asyncio.get_event_loop().call_later(
+                1.0, lambda p=pg: asyncio.ensure_future(self._retry_place_pg(p))
+            )
 
     # ------------------------------------------------------------- pubsub
     async def publish(self, channel: str, payload):
@@ -174,15 +304,35 @@ class GcsServer:
         await self.publish("node", {"event": "added", "node": self.nodes[node_id].public()})
         return {"node_id": node_id, "num_nodes": len(self.nodes)}
 
-    def handle_resource_report(self, conn, node_id, available):
+    def handle_resource_report(self, conn, node_id, available, pending=None):
         node = self.nodes.get(node_id)
         if node is None:
             return False
         node.available = ResourceSet(available)
         node.last_report = time.monotonic()
+        node.pending_demand = pending or []
         if not node.alive:
             node.alive = True  # recovered
         return True
+
+    def handle_get_cluster_load(self, conn):
+        """Autoscaler view: per-node queued demand + resource slack
+        (parity: autoscaler's LoadMetrics from resource reports)."""
+        return {
+            "nodes": {
+                n.node_id: {
+                    "alive": n.alive,
+                    "total": n.total.to_dict(),
+                    "available": n.available.to_dict(),
+                    "pending": getattr(n, "pending_demand", []),
+                }
+                for n in self.nodes.values()
+            },
+            "pending_actors": sum(
+                1 for a in self.actors.values()
+                if a.state in (PENDING, RESTARTING)
+            ),
+        }
 
     def handle_get_nodes(self, conn):
         return [n.public() for n in self.nodes.values()]
@@ -224,12 +374,14 @@ class GcsServer:
         if not overwrite and k in self.kv:
             return False
         self.kv[k] = value
+        self._store_dirty = True
         return True
 
     def handle_kv_get(self, conn, ns, key):
         return self.kv.get((ns, key))
 
     def handle_kv_del(self, conn, ns, key):
+        self._store_dirty = True
         return self.kv.pop((ns, key), None) is not None
 
     def handle_kv_keys(self, conn, ns, prefix=""):
@@ -238,6 +390,7 @@ class GcsServer:
     # ---------------------------------------------------------- functions
     def handle_register_function(self, conn, fn_id, blob):
         self.functions[fn_id] = blob
+        self._store_dirty = True
         return True
 
     def handle_get_function(self, conn, fn_id):
@@ -286,6 +439,7 @@ class GcsServer:
             bundle_index=bundle_index,
         )
         self.actors[actor_id] = info
+        self._store_dirty = True
         if not detached:
             self._conn_owned_actors.setdefault(conn, set()).add(actor_id)
         await self._schedule_actor(info)
@@ -323,13 +477,35 @@ class GcsServer:
             info.sched_attempts += 1
             node_id = pg.placement[idx]
         else:
-            views = [n.view() for n in self.nodes.values()]
-            node_id = hybrid_policy(
-                demand,
-                views,
-                spread_threshold=_config.scheduler_spread_threshold,
-                top_k_fraction=_config.scheduler_top_k_fraction,
-            )
+            hint = getattr(info, "restore_node_hint", None)
+            if hint is not None:
+                # store-restored actor: its worker may still be LIVE on the
+                # node it ran on — route there first so the raylet adopts it
+                # instead of a fresh instance spawning elsewhere. One shot:
+                # fall back to the policy if the node never comes back.
+                if hint in self.nodes and self.nodes[hint].alive:
+                    info.restore_node_hint = None
+                    node_id = hint
+                elif info.sched_attempts < 20:
+                    info.sched_attempts += 1
+                    asyncio.get_running_loop().call_later(
+                        0.5,
+                        lambda: asyncio.ensure_future(self._retry_schedule(info)),
+                    )
+                    return
+                else:
+                    info.restore_node_hint = None
+                    node_id = None
+            else:
+                node_id = None
+            if node_id is None:
+                views = [n.view() for n in self.nodes.values()]
+                node_id = hybrid_policy(
+                    demand,
+                    views,
+                    spread_threshold=_config.scheduler_spread_threshold,
+                    top_k_fraction=_config.scheduler_top_k_fraction,
+                )
         if node_id is None or node_id not in self.nodes:
             # queue until resources free up: retry on next resource report
             asyncio.get_running_loop().call_later(
@@ -369,6 +545,7 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None:
             return False
+        self._store_dirty = True
         info.state = ALIVE
         info.address = address
         info.node_id = node_id
@@ -395,6 +572,7 @@ class GcsServer:
             await self._mark_actor_dead(info, reason)
 
     async def _mark_actor_dead(self, info: ActorInfo, reason: str):
+        self._store_dirty = True
         info.state = DEAD
         info.death_reason = reason
         info.address = None
@@ -486,6 +664,7 @@ class GcsServer:
             detached=detached,
         )
         self.placement_groups[pg_id] = info
+        self._store_dirty = True
         if not detached:
             self._conn_owned_pgs.setdefault(conn, set()).add(pg_id)
         deadline = time.monotonic() + create_timeout
@@ -495,6 +674,48 @@ class GcsServer:
                 return {"state": "CREATED", "placement": info.placement}
             await asyncio.sleep(0.1)
         return {"state": "PENDING", "placement": None}
+
+    async def _retry_place_pg(self, info: PlacementGroupInfo, attempts: int = 0):
+        """Keep trying to place a restored (detached) PG as nodes register.
+
+        A restored placement is RE-ADOPTED: the original nodes still hold the
+        bundle reservations (reserve_bundle is idempotent), so we re-confirm
+        on those exact nodes. If a placement node never re-registers, fall
+        back to placing fresh."""
+        if info.pg_id not in self.placement_groups:
+            return
+        if info.placement:
+            missing = [n for n in info.placement if n not in self.nodes
+                       or not self.nodes[n].alive]
+            if not missing:
+                ok = True
+                for idx, node_id in enumerate(info.placement):
+                    try:
+                        ok = ok and await self.nodes[node_id].conn.call(
+                            "reserve_bundle", pg_id=info.pg_id,
+                            bundle_index=idx, resources=info.bundles[idx],
+                            timeout=10,
+                        )
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        ok = False
+                if ok:
+                    info.state = "CREATED"
+                    return
+            if attempts < 30:
+                asyncio.get_event_loop().call_later(
+                    1.0, lambda: asyncio.ensure_future(
+                        self._retry_place_pg(info, attempts + 1)
+                    )
+                )
+                return
+            info.placement = None  # original nodes gone: place fresh
+            info.state = "PENDING"
+        if not await self._try_place_pg(info):
+            asyncio.get_event_loop().call_later(
+                1.0, lambda: asyncio.ensure_future(
+                    self._retry_place_pg(info, attempts + 1)
+                )
+            )
 
     async def _try_place_pg(self, info: PlacementGroupInfo) -> bool:
         views = [n.view() for n in self.nodes.values()]
@@ -531,10 +752,12 @@ class GcsServer:
             reserved.append((idx, node_id))
         info.placement = placement
         info.state = "CREATED"
+        self._store_dirty = True
         await self.publish("pg", {"pg_id": info.pg_id, "state": "CREATED"})
         return True
 
     async def handle_remove_placement_group(self, conn, pg_id):
+        self._store_dirty = True
         info = self.placement_groups.pop(pg_id, None)
         if info is None:
             return False
@@ -588,11 +811,13 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--store", default=None,
+                        help="snapshot file for GCS fault tolerance")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     async def run():
-        gcs = GcsServer(host=args.host, port=args.port)
+        gcs = GcsServer(host=args.host, port=args.port, store_path=args.store)
         addr = await gcs.start()
         print(f"GCS_ADDRESS={addr}", flush=True)
         await asyncio.Event().wait()

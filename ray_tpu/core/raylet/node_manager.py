@@ -145,15 +145,38 @@ class Raylet:
         period = _config.health_check_period_ms / 1000
         while True:
             try:
+                if self.gcs is None or self.gcs.closed:
+                    await self._reconnect_gcs()
                 await self.gcs.call(
                     "resource_report",
                     node_id=self.node_id,
                     available=self.available.to_dict(),
+                    # autoscaler signal: what this node is queueing
+                    pending=[
+                        lr.demand.to_dict() for lr in self.pending_leases[:20]
+                    ],
                 )
                 self.cluster_view = await self.gcs.call("get_resource_view")
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
             await asyncio.sleep(period)
+
+    async def _reconnect_gcs(self):
+        """GCS died (restart under fault tolerance): re-dial and re-register
+        this node so a store-restored GCS regains the cluster."""
+        self.gcs = await rpc.connect(
+            self.gcs_address, handler=self,
+            name=f"raylet-{self.node_id}->gcs", retries=3, retry_delay=0.3,
+        )
+        await self.gcs.call(
+            "register_node",
+            node_id=self.node_id,
+            address=self.server.address,
+            session=self.session,
+            resources=self.total.to_dict(),
+            labels=self._labels(),
+        )
+        logger.warning("re-registered with GCS at %s", self.gcs_address)
 
     async def _poll_loop(self):
         while True:
@@ -227,7 +250,8 @@ class Raylet:
         else:
             self.available = self.available.add(demand)
 
-    def _spillback_target(self, demand: ResourceSet) -> Optional[str]:
+    def _spillback_target(self, demand: ResourceSet,
+                          require_available: bool = False) -> Optional[str]:
         views = []
         for nid, v in self.cluster_view.items():
             if nid == self.node_id or not v.get("alive"):
@@ -241,7 +265,12 @@ class Raylet:
             )
         pick = hybrid_policy(demand, views)
         if pick is None:
-            # any node that could EVER fit it
+            if require_available:
+                # busy-node offload must target free capacity ONLY: falling
+                # back to could-ever-fit nodes ping-pongs leases between two
+                # busy peers until the driver's hop bound trips
+                return None
+            # any node that could EVER fit it (this node never can)
             for v in views:
                 if v.total.fits(demand):
                     return self.cluster_view[v.node_id]["address"]
@@ -290,8 +319,11 @@ class Raylet:
             token = self._acquire_for(lease)
             if token is None:
                 # resources busy: after a grace period, offload to a peer
+                # with free capacity NOW (never to another busy node)
                 if lease.allow_spillback and now - lease.queued_at >= 0.5:
-                    target = self._spillback_target(lease.demand)
+                    target = self._spillback_target(
+                        lease.demand, require_available=True
+                    )
                     if target:
                         self.pending_leases.remove(lease)
                         lease.future.set_result({"spillback": target})
@@ -371,6 +403,15 @@ class Raylet:
         resources from the bundle's reservation (same as PG task leases in
         _acquire_for) — NOT from node availability, which the bundle already
         debited; double-booking starved plain tasks (round-3 fix)."""
+        existing = self.pool.get_actor_worker(actor_id)
+        if existing is not None and existing.address:
+            # GCS restarted (fault tolerance) and is rescheduling an actor
+            # that never died: adopt the live worker instead of spawning a
+            # duplicate (which would also double-book its resources)
+            asyncio.ensure_future(
+                self._announce_adopted_actor(actor_id, existing.address)
+            )
+            return True
         demand = ResourceSet(resources)
         token = self._acquire(demand, pg_id, bundle_index)
         if token is None:
@@ -385,6 +426,23 @@ class Raylet:
         handle = self.pool.start_worker(actor_id=actor_id)
         handle.state = ACTOR
         return True
+
+    async def _announce_adopted_actor(self, actor_id, address):
+        """actor_ready for an adopted live worker, retried: if the one-shot
+        notify is lost (GCS reconnect window) the actor would sit PENDING
+        forever — no other sender exists for an already-initialized actor."""
+        for _ in range(20):
+            try:
+                if self.gcs is not None and not self.gcs.closed:
+                    await self.gcs.call(
+                        "actor_ready", actor_id=actor_id,
+                        address=address, node_id=self.node_id, timeout=10,
+                    )
+                    return
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+            await asyncio.sleep(0.5)
+        logger.warning("adopted-actor announce failed for %s", actor_id.hex())
 
     async def handle_kill_actor_worker(self, conn, actor_id):
         handle = self.pool.get_actor_worker(actor_id)
@@ -405,6 +463,10 @@ class Raylet:
     # ---------------------------------------------------- placement groups
     def handle_reserve_bundle(self, conn, pg_id, bundle_index, resources):
         demand = ResourceSet(resources)
+        if (pg_id, bundle_index) in self.bundles:
+            # idempotent: a store-restored GCS re-places detached PGs whose
+            # bundles this raylet still holds — don't double-subtract
+            return True
         if not self.available.fits(demand):
             return False
         self.available = self.available.subtract(demand)

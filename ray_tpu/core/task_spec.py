@@ -47,6 +47,9 @@ class TaskSpec:
     scheduling_strategy: Any = None
     placement_group_id: Any = None
     placement_group_bundle_index: int = -1
+    # packed runtime env (runtime_env.pack wire dict); the executing worker
+    # applies it around the task / at actor init
+    runtime_env: Optional[dict] = None
 
     def return_refs(self) -> List[ObjectRef]:
         return [

@@ -39,6 +39,7 @@ class WorkerAgent(CoreWorker):
         self.actor_id: Optional[bytes] = None
         self._actor_ready = threading.Event()
         self._actor_init_error: Optional[BaseException] = None
+        self._applier = None  # runtime_env.WorkerEnvApplier, lazy
 
     # -------------------------------------------------------- registration
     def register_with_raylet(self, startup_token: int):
@@ -67,8 +68,33 @@ class WorkerAgent(CoreWorker):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec_pool, self._execute, spec)
 
+    def _env_applier(self):
+        if self._applier is None:
+            from ray_tpu.runtime_env import WorkerEnvApplier
+
+            stage_root = os.path.join(
+                "/tmp", "ray_tpu", self.session, "runtime_env"
+            )
+            os.makedirs(stage_root, exist_ok=True)
+            self._applier = WorkerEnvApplier(
+                stage_root,
+                # retrying: package downloads must ride out a GCS
+                # fault-tolerance restart window like load_function does
+                lambda ns, k: self.io.run(
+                    self._gcs_call_retrying("kv_get", ns=ns, key=k, timeout=60)
+                ),
+            )
+        return self._applier
+
     def _execute(self, spec: ts.TaskSpec) -> dict:
+        applied = False
+        self._record_task_event(spec, "RUNNING")
         try:
+            if spec.runtime_env:
+                # mark BEFORE apply: a partial apply (missing package, GCS
+                # hiccup) must still be rolled back by the finally-reset
+                applied = True
+                self._env_applier().apply(spec.runtime_env)
             fn = self.io.run(self.load_function(spec.fn_id))
             args, kwargs = ts.decode_args(
                 spec.args, spec.kwargs, lambda refs: self.get(refs, None)
@@ -88,6 +114,12 @@ class WorkerAgent(CoreWorker):
             return self._attach_borrows(spec, self._error_result(spec, e, system=True))
         except BaseException as e:  # noqa: BLE001
             return self._attach_borrows(spec, self._error_result(spec, e))
+        finally:
+            if applied:
+                # pooled workers are reused across tasks: never leak one
+                # task's env into the next (the reference dedicates workers
+                # per runtime env instead)
+                self._env_applier().reset()
 
     def _attach_borrows(self, spec: ts.TaskSpec, result: dict) -> dict:
         """Refs deserialized here that survive the task are borrows; announce
@@ -204,6 +236,9 @@ class WorkerAgent(CoreWorker):
     def _init_actor(self, spec_blob):
         try:
             spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
+            if spec.runtime_env:
+                # actor workers are dedicated: the env applies for life
+                self._env_applier().apply(spec.runtime_env)
             cls = self.io.run(self.load_function(spec.fn_id))
             args, kwargs = ts.decode_args(
                 spec.args, spec.kwargs, lambda refs: self.get(refs, None)
@@ -253,6 +288,7 @@ class WorkerAgent(CoreWorker):
         self._actor_ready.wait(timeout=_config.worker_startup_timeout_s)
         if self._actor_init_error is not None:
             return self._error_result(spec, self._actor_init_error)
+        self._record_task_event(spec, "RUNNING")
         try:
             method = getattr(self.actor_instance, spec.actor_method)
             args, kwargs = ts.decode_args(

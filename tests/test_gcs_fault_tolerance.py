@@ -1,0 +1,125 @@
+"""GCS fault tolerance: SIGKILL the GCS, restart it on the same address with
+the snapshot store, and the cluster recovers — raylets re-register, the
+driver reconnects, KV/functions/detached actors survive.
+
+Parity: src/ray/gcs/store_client/ (Redis-backed GCS FT); ours is a file
+snapshot + reconnect loops (gcs/server.py _durable_state).
+"""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.address)
+    yield ray_tpu, c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _gcs_call(ray, method, **kw):
+    from ray_tpu.api import _global_worker
+
+    core = _global_worker().backend.core
+
+    async def call():
+        return await core.gcs.call(method, timeout=30, **kw)
+
+    return core.io.run(call(), timeout=60)
+
+
+def test_gcs_restart_preserves_state_and_cluster_recovers(cluster):
+    ray, c = cluster
+
+    # durable state: KV + a detached named actor
+    _gcs_call(ray, "kv_put", ns="test", key="alpha", value=b"42")
+
+    @ray.remote
+    class Keeper:
+        def __init__(self):
+            self.v = 7
+
+        def get(self):
+            return self.v
+
+        def bump(self):
+            self.v += 1
+            return self.v
+
+    keeper = Keeper.options(name="keeper", lifetime="detached").remote()
+    assert ray.get(keeper.get.remote(), timeout=60) == 7
+    assert ray.get(keeper.bump.remote(), timeout=60) == 8
+
+    # snapshot loop runs every 1s; let it capture the actor
+    time.sleep(2.5)
+
+    c.kill_gcs()
+    time.sleep(0.5)
+    c.restart_gcs()
+
+    # driver + raylet watchdogs re-register within a few seconds
+    deadline = time.time() + 30
+    nodes = []
+    while time.time() < deadline:
+        try:
+            nodes = [n for n in ray.nodes() if n["Alive"]]
+            if nodes:
+                break
+        except Exception:  # noqa: BLE001 - reconnect in progress
+            pass
+        time.sleep(0.5)
+    assert nodes, "raylet must re-register with the restarted GCS"
+
+    # durable KV survived
+    assert _gcs_call(ray, "kv_get", ns="test", key="alpha") == b"42"
+
+    # the detached actor is still resolvable by name, and because its worker
+    # never died the raylet ADOPTS the live instance (state intact: 8), no
+    # duplicate spawn
+    deadline = time.time() + 60
+    value = None
+    while time.time() < deadline:
+        try:
+            h = ray.get_actor("keeper")
+            value = ray.get(h.get.remote(), timeout=30)
+            break
+        except Exception:  # noqa: BLE001 - still rescheduling
+            time.sleep(0.5)
+    assert value == 8, f"live detached actor must be adopted, got {value!r}"
+
+    # and the cluster still runs fresh work end-to-end
+    @ray.remote
+    def f(x):
+        return x * 3
+
+    assert ray.get(f.remote(5), timeout=60) == 15
+
+
+def test_gcs_two_restart_cycles(cluster):
+    """Two kill/restart cycles: the second kill must target the restarted
+    GCS, and durable KV must survive both."""
+    ray, c = cluster
+    _gcs_call(ray, "kv_put", ns="t2", key="k", value=b"v1")
+    time.sleep(1.5)
+    for cycle in range(2):
+        c.kill_gcs()
+        time.sleep(0.3)
+        c.restart_gcs()
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            try:
+                if _gcs_call(ray, "kv_get", ns="t2", key="k") == b"v1":
+                    ok = True
+                    break
+            except Exception:  # noqa: BLE001 - reconnecting
+                pass
+            time.sleep(0.5)
+        assert ok, f"KV lost after restart cycle {cycle}"
