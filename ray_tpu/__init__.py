@@ -22,6 +22,7 @@ from ray_tpu.api import (
     remote,
     shutdown,
     wait,
+    timeline,
 )
 from ray_tpu.core.config import _config
 from ray_tpu.core.refs import ObjectRef
@@ -43,6 +44,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "nodes",
+    "timeline",
     "ObjectRef",
     "exceptions",
 ]

@@ -1,0 +1,191 @@
+"""Runtime environments: per-task/actor env vars, working_dir, py_modules.
+
+Parity: python/ray/_private/runtime_env/ + dashboard/modules/runtime_env/
+runtime_env_agent.py:271 (the reference stages packages through the GCS and
+an agent applies them before the worker runs user code). TPU-native/compact
+design: the driver zips local dirs and uploads them to the GCS KV
+(ns="runtime_env_pkg", content-addressed); the executing worker downloads,
+extracts once per package hash, and applies the env before running the task.
+Pip/conda installs are deliberately out of scope (this image forbids
+installs); `env_vars`, `working_dir`, and `py_modules` cover the hermetic
+cases.
+
+Wire format (rides the TaskSpec):
+    {"env_vars": {...}, "working_dir": "<pkg hash>"|None,
+     "py_modules": ["<pkg hash>", ...]}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Any, Dict, Optional
+
+_PKG_NS = "runtime_env_pkg"
+_MAX_PKG_BYTES = 100 * 1024 * 1024
+
+_KNOWN_KEYS = {"env_vars", "working_dir", "py_modules"}
+
+
+def validate(env: Dict[str, Any]) -> Dict[str, Any]:
+    unknown = set(env) - _KNOWN_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)} "
+            f"(supported: {sorted(_KNOWN_KEYS)}; pip/conda installs are not "
+            f"available in this environment)"
+        )
+    ev = env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str) for k, v in ev.items()):
+        raise ValueError("runtime_env env_vars must be str->str")
+    return env
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > _MAX_PKG_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PKG_BYTES})"
+        )
+    return data
+
+
+def dirs_fingerprint(env: Dict[str, Any]) -> str:
+    """Cheap change-detector over the env's local dirs (file count, total
+    size, max mtime) — drives the driver-side pack cache."""
+    parts = []
+    dirs = [env.get("working_dir")] if env.get("working_dir") else []
+    dirs += env.get("py_modules") or []
+    for d in dirs:
+        count = size = 0
+        mtime = 0.0
+        for root, subdirs, files in os.walk(
+            os.path.abspath(os.path.expanduser(d))
+        ):
+            subdirs[:] = [x for x in subdirs if x != "__pycache__"]
+            for f in files:
+                try:
+                    st = os.stat(os.path.join(root, f))
+                except OSError:
+                    continue
+                count += 1
+                size += st.st_size
+                mtime = max(mtime, st.st_mtime)
+        parts.append(f"{d}:{count}:{size}:{mtime:.6f}")
+    return "|".join(parts)
+
+
+def pack(env: Dict[str, Any], kv_put) -> Dict[str, Any]:
+    """Driver side: upload dir packages, return the wire dict.
+
+    kv_put(ns, key, value) stores into the GCS KV (content-addressed, so
+    re-uploads of identical trees are idempotent).
+    """
+    env = validate(env)
+    wire: Dict[str, Any] = {"env_vars": dict(env.get("env_vars") or {})}
+
+    def upload(path: str) -> str:
+        data = _zip_dir(os.path.abspath(os.path.expanduser(path)))
+        h = hashlib.blake2b(data, digest_size=16).hexdigest()
+        kv_put(_PKG_NS, h, data)
+        return h
+
+    wd = env.get("working_dir")
+    wire["working_dir"] = upload(wd) if wd else None
+    wire["py_modules"] = [upload(p) for p in env.get("py_modules") or []]
+    return wire
+
+
+def env_key(wire: Dict[str, Any]) -> str:
+    """Stable identity of a wire env (worker-side apply cache key)."""
+    return hashlib.blake2b(
+        json.dumps(wire, sort_keys=True).encode(), digest_size=8
+    ).hexdigest()
+
+
+class WorkerEnvApplier:
+    """Worker side: stage packages and apply/reset envs between tasks.
+
+    Our pooled workers are generic (the reference dedicates workers per
+    runtime env); tasks run one-at-a-time per worker, so apply() before and
+    reset() after a task keeps envs from leaking across tasks.
+    """
+
+    def __init__(self, stage_root: str, kv_get):
+        self._stage_root = stage_root
+        self._kv_get = kv_get
+        self._staged: Dict[str, str] = {}     # pkg hash → extracted dir
+        self._saved_env: Dict[str, Optional[str]] = {}
+        self._added_paths: list = []
+        self._saved_cwd: Optional[str] = None
+
+    def _stage(self, pkg_hash: str) -> str:
+        path = self._staged.get(pkg_hash)
+        if path:
+            return path
+        path = os.path.join(self._stage_root, pkg_hash)
+        if not os.path.isdir(path):
+            data = self._kv_get(_PKG_NS, pkg_hash)
+            if data is None:
+                raise RuntimeError(f"runtime_env package {pkg_hash} not in GCS")
+            tmp = path + f".tmp{os.getpid()}"
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                z.extractall(tmp)
+            try:
+                os.replace(tmp, path)  # racing workers: first one wins
+            except OSError:
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        self._staged[pkg_hash] = path
+        return path
+
+    def apply(self, wire: Dict[str, Any]) -> None:
+        for k, v in (wire.get("env_vars") or {}).items():
+            self._saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        for h in wire.get("py_modules") or []:
+            p = self._stage(h)
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                self._added_paths.append(p)
+        wd = wire.get("working_dir")
+        if wd:
+            p = self._stage(wd)
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                self._added_paths.append(p)
+            self._saved_cwd = os.getcwd()
+            os.chdir(p)
+
+    def reset(self) -> None:
+        for k, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        self._saved_env.clear()
+        for p in self._added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        self._added_paths.clear()
+        if self._saved_cwd is not None:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass
+            self._saved_cwd = None
