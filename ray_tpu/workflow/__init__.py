@@ -1,0 +1,25 @@
+"""Workflow: durable DAG execution (parity: python/ray/workflow/).
+
+Build a DAG with fn.bind(...), then workflow.run(dag) — every step's output
+checkpoints to storage, and resume() re-runs only incomplete steps.
+"""
+
+from ray_tpu.workflow.api import (
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "init",
+    "run",
+    "run_async",
+    "resume",
+    "get_status",
+    "get_output",
+    "list_all",
+]

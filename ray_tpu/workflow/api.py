@@ -1,0 +1,151 @@
+"""Workflow API: durable DAG execution with per-step checkpoints.
+
+Parity: python/ray/workflow/api.py (`run` :120, `resume` :232) +
+workflow_executor.py. A workflow is a bound DAG (ray_tpu.dag nodes, built
+with fn.bind(...)); run() executes it step-by-step, checkpointing every
+step's output through WorkflowStorage. resume() re-executes the same DAG —
+steps with a checkpoint are skipped, so only incomplete work re-runs.
+
+Step identity is structural: a deterministic DFS over the DAG assigns each
+FunctionNode an index+name id, stable across runs of the same DAG shape
+(the reference derives step ids the same way for unnamed steps).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+from ray_tpu.workflow.storage import WorkflowStorage
+
+_storage: Optional[WorkflowStorage] = None
+_registered: Dict[str, DAGNode] = {}  # workflow_id → dag (for resume)
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the storage root (default /tmp/ray_tpu_workflows)."""
+    global _storage
+    _storage = WorkflowStorage(storage)
+
+
+def _store() -> WorkflowStorage:
+    global _storage
+    if _storage is None:
+        _storage = WorkflowStorage()
+    return _storage
+
+
+class _Executor:
+    def __init__(self, workflow_id: str, store: WorkflowStorage):
+        self.workflow_id = workflow_id
+        self.store = store
+        self.counter = 0
+        self._memo: Dict[int, Any] = {}  # id(node) → result (diamond DAGs)
+
+    def exec_node(self, node: Any, input_value: Any) -> Any:
+        if isinstance(node, InputNode):
+            return input_value
+        if not isinstance(node, DAGNode):
+            return node  # plain value
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"workflows execute function DAGs; got {type(node).__name__}"
+            )
+        # a node referenced by several downstream nodes executes ONCE —
+        # diamonds must not re-run (or re-number) shared upstream steps
+        if id(node) in self._memo:
+            return self._memo[id(node)]
+        # deterministic structural id: DFS pre-order position + fn name.
+        # Claim the index BEFORE recursing so the id reflects the node's
+        # position, then resolve upstream args depth-first.
+        fn = node._fn
+        name = getattr(
+            getattr(fn, "_function", None), "__name__", None
+        ) or getattr(fn, "__name__", "step")
+        step_id = f"{self.counter:04d}_{name}"
+        self.counter += 1
+        args = [self.exec_node(a, input_value) for a in node._bound_args]
+        kwargs = {
+            k: self.exec_node(v, input_value)
+            for k, v in sorted(node._bound_kwargs.items())
+        }
+        if self.store.has_step(self.workflow_id, step_id):
+            value = self.store.load_step(self.workflow_id, step_id)
+        else:
+            import ray_tpu
+
+            value = ray_tpu.get(fn.remote(*args, **kwargs))
+            self.store.save_step(self.workflow_id, step_id, value)
+        self._memo[id(node)] = value
+        return value
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None) -> Any:
+    """Execute a DAG durably; returns the final output. Re-running with the
+    same workflow_id (or resume()) skips checkpointed steps."""
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:8]}"
+    store = _store()
+    _registered[workflow_id] = dag
+    store.init_workflow(workflow_id)
+    try:
+        out = _Executor(workflow_id, store).exec_node(dag, input_value)
+    except BaseException as e:
+        store.set_status(workflow_id, "FAILED", error=repr(e))
+        raise
+    store.save_output(workflow_id, out)
+    return out
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None):
+    """Start a workflow on a background thread; returns (workflow_id,
+    thread). Use get_output() for the result."""
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:8]}"
+    t = threading.Thread(
+        target=lambda: run(
+            dag, workflow_id=workflow_id, input_value=input_value
+        ),
+        daemon=True,
+        name=f"workflow-{workflow_id}",
+    )
+    t.start()
+    return workflow_id, t
+
+def resume(workflow_id: str, dag: Optional[DAGNode] = None,
+           input_value: Any = None) -> Any:
+    """Re-drive a workflow: checkpointed steps are skipped, the rest run.
+
+    The reference persists the DAG itself; we re-run the caller-supplied DAG
+    (or the one registered by run() in this process) against the stored
+    checkpoints — same step ids, same skipping semantics."""
+    status = _store().get_status(workflow_id)
+    if status is None:
+        raise ValueError(f"unknown workflow {workflow_id!r}")
+    if status["status"] == "SUCCESSFUL":
+        return _store().load_output(workflow_id)
+    dag = dag or _registered.get(workflow_id)
+    if dag is None:
+        raise ValueError(
+            f"workflow {workflow_id!r} has no DAG in this process; pass dag="
+        )
+    return run(dag, workflow_id=workflow_id, input_value=input_value)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    s = _store().get_status(workflow_id)
+    return s["status"] if s else None
+
+
+def get_output(workflow_id: str) -> Any:
+    return _store().load_output(workflow_id)
+
+
+def list_all() -> List[tuple]:
+    store = _store()
+    return [
+        (wid, (store.get_status(wid) or {}).get("status"))
+        for wid in store.list_workflows()
+    ]

@@ -1,0 +1,100 @@
+"""Workflow storage: durable per-step checkpoints + workflow status.
+
+Parity: python/ray/workflow/workflow_storage.py — every step's output is
+checkpointed so a crashed or cancelled workflow resumes from the last
+completed step instead of re-running the whole DAG. Layout (filesystem,
+root configurable via workflow.init):
+
+    <root>/<workflow_id>/status.json
+    <root>/<workflow_id>/steps/<step_id>.pkl      (pickled step output)
+    <root>/<workflow_id>/output.pkl               (final result)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Any, List, Optional
+
+_DEFAULT_ROOT = os.path.join("/tmp", "ray_tpu_workflows")
+
+
+class WorkflowStorage:
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or _DEFAULT_ROOT
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------- layout
+    def _dir(self, workflow_id: str) -> str:
+        return os.path.join(self.root, workflow_id)
+
+    def _steps_dir(self, workflow_id: str) -> str:
+        return os.path.join(self._dir(workflow_id), "steps")
+
+    def _status_path(self, workflow_id: str) -> str:
+        return os.path.join(self._dir(workflow_id), "status.json")
+
+    # ------------------------------------------------------------- status
+    def init_workflow(self, workflow_id: str) -> None:
+        os.makedirs(self._steps_dir(workflow_id), exist_ok=True)
+        self.set_status(workflow_id, "RUNNING")
+
+    def set_status(self, workflow_id: str, status: str,
+                   error: Optional[str] = None) -> None:
+        path = self._status_path(workflow_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"status": status, "error": error, "time": time.time()}, f
+            )
+        os.replace(tmp, path)
+
+    def get_status(self, workflow_id: str) -> Optional[dict]:
+        try:
+            with open(self._status_path(workflow_id)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def list_workflows(self) -> List[str]:
+        try:
+            return sorted(
+                d for d in os.listdir(self.root)
+                if os.path.isdir(self._dir(d))
+            )
+        except OSError:
+            return []
+
+    # -------------------------------------------------------------- steps
+    def has_step(self, workflow_id: str, step_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._steps_dir(workflow_id), step_id + ".pkl")
+        )
+
+    def save_step(self, workflow_id: str, step_id: str, value: Any) -> None:
+        path = os.path.join(self._steps_dir(workflow_id), step_id + ".pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)
+
+    def load_step(self, workflow_id: str, step_id: str) -> Any:
+        path = os.path.join(self._steps_dir(workflow_id), step_id + ".pkl")
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    # ------------------------------------------------------------- output
+    def save_output(self, workflow_id: str, value: Any) -> None:
+        path = os.path.join(self._dir(workflow_id), "output.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)
+        self.set_status(workflow_id, "SUCCESSFUL")
+
+    def load_output(self, workflow_id: str) -> Any:
+        path = os.path.join(self._dir(workflow_id), "output.pkl")
+        with open(path, "rb") as f:
+            return pickle.load(f)

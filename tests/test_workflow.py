@@ -1,0 +1,98 @@
+"""Workflow layer: durable DAG execution, checkpointing, resume.
+
+Parity: python/ray/workflow/ (api.py run/resume, workflow_storage.py).
+"""
+
+import os
+
+import pytest
+
+
+@pytest.fixture
+def wf(tmp_path):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    workflow.init(str(tmp_path / "wf_store"))
+    yield ray_tpu, workflow
+    ray_tpu.shutdown()
+
+
+def test_workflow_runs_dag_and_checkpoints(wf, tmp_path):
+    ray, workflow = wf
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(double.bind(3), double.bind(4))
+    out = workflow.run(dag, workflow_id="w1")
+    assert out == 14
+    assert workflow.get_status("w1") == "SUCCESSFUL"
+    assert workflow.get_output("w1") == 14
+    assert ("w1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed_steps(wf, tmp_path):
+    ray, workflow = wf
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+
+    @ray.remote
+    def record(tag):
+        # side-effect counter: one file per EXECUTION
+        n = len(os.listdir(marker_dir))
+        (marker_dir / f"{tag}-{n}").write_text("x")
+        return tag
+
+    @ray.remote
+    def fail_once(a, b):
+        flag = marker_dir / "fail-armed"
+        if flag.exists():
+            flag.unlink()
+            raise RuntimeError("injected step failure")
+        return f"{a}+{b}"
+
+    (marker_dir / "fail-armed").write_text("x")
+    dag = fail_once.bind(record.bind("left"), record.bind("right"))
+
+    with pytest.raises(Exception, match="injected"):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    executed = len(list(marker_dir.iterdir()))  # left + right ran
+
+    out = workflow.resume("w2")
+    assert out == "left+right"
+    assert workflow.get_status("w2") == "SUCCESSFUL"
+    # the two record() steps were checkpointed: resume must NOT re-run them
+    assert len(list(marker_dir.iterdir())) == executed
+
+
+def test_workflow_resume_of_finished_returns_output(wf):
+    ray, workflow = wf
+
+    @ray.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w3")
+    assert workflow.resume("w3") == 1
+
+
+def test_workflow_input_value(wf):
+    ray, workflow = wf
+    from ray_tpu.dag import InputNode
+
+    @ray.remote
+    def inc(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = inc.bind(inp)
+    assert workflow.run(dag, workflow_id="w4", input_value=41) == 42
