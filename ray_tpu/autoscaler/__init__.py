@@ -1,0 +1,11 @@
+"""Autoscaler: demand-driven node scaling (parity: python/ray/autoscaler/).
+
+StandardAutoscaler is the policy loop; NodeProvider is the lifecycle
+interface (LocalNodeProvider launches raylets on this host; cloud/TPU-pod
+providers implement the same three methods).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]

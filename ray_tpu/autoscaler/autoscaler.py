@@ -1,0 +1,143 @@
+"""StandardAutoscaler: demand-driven node scaling over a NodeProvider.
+
+Parity: python/ray/autoscaler/_private/autoscaler.py:172 — the reconcile
+loop reads cluster load (queued lease demand + pending actors from the GCS,
+the LoadMetrics analog), launches nodes when demand goes unserved past an
+upscale delay, and reclaims nodes idle past an idle timeout, bounded by
+[min_workers, max_workers]. Providers do the actual lifecycle
+(node_provider.py); this class is pure policy.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        provider: NodeProvider,
+        gcs_call,                    # fn(method, **kw) -> result (sync)
+        min_workers: int = 0,
+        max_workers: int = 4,
+        upscale_delay_s: float = 1.0,
+        idle_timeout_s: float = 30.0,
+        node_resources: Optional[Dict[str, float]] = None,
+        poll_period_s: float = 1.0,
+    ):
+        self.provider = provider
+        self.gcs_call = gcs_call
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.upscale_delay_s = upscale_delay_s
+        self.idle_timeout_s = idle_timeout_s
+        self.node_resources = node_resources or {"CPU": 1}
+        self.poll_period_s = poll_period_s
+        self._demand_since: Optional[float] = None
+        self._idle_since: Dict[str, float] = {}
+        self._requested: List[Dict[str, float]] = []  # sdk.request_resources
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: List[str] = []   # human-readable decisions (dashboard)
+
+    # ------------------------------------------------------------- control
+    def start(self) -> "StandardAutoscaler":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def request_resources(self, bundles: List[Dict[str, float]]) -> None:
+        """Explicit demand hint (parity: autoscaler/sdk.py request_resources):
+        scale to fit `bundles` regardless of queued load."""
+        self._requested = list(bundles)
+
+    # -------------------------------------------------------------- policy
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_period_s):
+            try:
+                self.reconcile()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("autoscaler reconcile failed")
+
+    def reconcile(self) -> None:
+        load = self.gcs_call("get_cluster_load")
+        if load is None:
+            return
+        nodes = load["nodes"]
+        my_nodes = set(self.provider.non_terminated_nodes())
+        alive = {nid: n for nid, n in nodes.items() if n["alive"]}
+        n_autoscaled = len(my_nodes)
+
+        # maintain the floor: launch (or replace dead) nodes up to min_workers
+        while n_autoscaled < self.min_workers:
+            nid = self.provider.create_node(dict(self.node_resources))
+            self.events.append(f"scale-up -> {nid} (min_workers floor)")
+            logger.info(self.events[-1])
+            n_autoscaled += 1
+
+        # ---- demand: queued lease bundles + pending actors + explicit hints
+        queued = [d for n in alive.values() for d in n["pending"]]
+        unserved = (
+            bool(queued)
+            or load.get("pending_actors", 0) > 0
+            or self._has_unfit_request(alive)
+        )
+        now = time.monotonic()
+        if unserved:
+            if self._demand_since is None:
+                self._demand_since = now
+            if (now - self._demand_since >= self.upscale_delay_s
+                    and n_autoscaled < self.max_workers):
+                nid = self.provider.create_node(dict(self.node_resources))
+                self.events.append(
+                    f"scale-up -> {nid} (queued={len(queued)}, "
+                    f"pending_actors={load.get('pending_actors', 0)})"
+                )
+                logger.info(self.events[-1])
+                self._demand_since = None  # re-arm: one node per delay window
+        else:
+            self._demand_since = None
+
+        # ---- idle scale-down (only nodes this autoscaler launched)
+        for nid in list(my_nodes):
+            info = alive.get(nid)
+            if info is None:
+                continue
+            busy = info["pending"] or info["available"] != info["total"]
+            if busy:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            if (now - first >= self.idle_timeout_s
+                    and len(my_nodes) > self.min_workers):
+                self.provider.terminate_node(nid)
+                my_nodes.discard(nid)
+                self._idle_since.pop(nid, None)
+                self.events.append(f"scale-down -> {nid} (idle)")
+                logger.info(self.events[-1])
+
+    def _has_unfit_request(self, alive: Dict[str, dict]) -> bool:
+        """True if any explicitly requested bundle fits on NO live node."""
+        from ray_tpu.core.resources import ResourceSet
+
+        for bundle in self._requested:
+            demand = ResourceSet(bundle)
+            if not any(
+                ResourceSet(n["total"]).fits(demand) for n in alive.values()
+            ):
+                return True
+        return False

@@ -1,0 +1,69 @@
+"""Node providers: how the autoscaler creates and destroys nodes.
+
+Parity: python/ray/autoscaler/node_provider.py (the provider interface all
+cloud integrations implement) + _private/fake_multi_node. The in-tree
+LocalNodeProvider launches raylet processes on this host — the real provider
+for single-host elasticity and the test double for the policy loop; cloud/
+pod providers implement the same three methods against their control plane
+(for TPU pods: the GKE/QR API would go here).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    def create_node(self, resources: Dict[str, float]) -> str:
+        """Launch a node; returns its node_id."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Raylet subprocesses on this host, joined to an existing GCS."""
+
+    def __init__(self, gcs_address: str, session: str,
+                 default_resources: Optional[Dict[str, float]] = None):
+        from ray_tpu.core.cluster_backend import ProcessGroup, _session_tmp_dir
+
+        self.gcs_address = gcs_address
+        self.session = session
+        self.default_resources = default_resources or {"CPU": 1}
+        self.procs = ProcessGroup(_session_tmp_dir(session))
+        self._nodes: Dict[str, object] = {}  # node_id → Popen
+
+    def create_node(self, resources: Optional[Dict[str, float]] = None) -> str:
+        from ray_tpu.core.cluster_backend import start_raylet
+
+        res = dict(resources or self.default_resources)
+        node_id = f"auto-{uuid.uuid4().hex[:8]}"
+        before = set(self.procs.procs)
+        start_raylet(
+            self.procs, self.gcs_address, self.session, node_id,
+            num_cpus=res.pop("CPU", 1), num_tpus=int(res.pop("TPU", 0)),
+            resources=res or None,
+        )
+        self._nodes[node_id] = next(
+            p for p in self.procs.procs if p not in before
+        )
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        p = self._nodes.pop(node_id, None)
+        if p is not None:
+            p.terminate()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [
+            nid for nid, p in self._nodes.items() if p.poll() is None
+        ]
+
+    def shutdown(self) -> None:
+        self.procs.shutdown()

@@ -1,0 +1,98 @@
+"""Autoscaler: scale-up on queued demand, scale-down on idle.
+
+Parity: autoscaler/_private/autoscaler.py:172 reconcile loop semantics.
+"""
+
+import time
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=c.address)
+    yield ray_tpu, c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _mk(ray, c, **kw):
+    from ray_tpu.api import _global_worker
+    from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+
+    core = _global_worker().backend.core
+
+    def gcs_call(method, **k):
+        async def call():
+            return await core.gcs.call(method, timeout=30, **k)
+
+        return core.io.run(call(), timeout=60)
+
+    provider = LocalNodeProvider(c.address, c.session)
+    return provider, StandardAutoscaler(provider, gcs_call, **kw)
+
+
+def test_scales_up_on_queued_demand_and_down_when_idle(cluster):
+    ray, c = cluster
+    provider, scaler = _mk(
+        ray, c, max_workers=2, upscale_delay_s=0.5, idle_timeout_s=3.0,
+        node_resources={"CPU": 2}, poll_period_s=0.3,
+    )
+    scaler.start()
+    try:
+        # the 1-CPU head can't serve CPU:2 tasks -> they queue -> scale up
+        @ray.remote(num_cpus=2)
+        def big(x):
+            return x + 1
+
+        refs = [big.remote(i) for i in range(3)]
+        assert ray.get(refs, timeout=120) == [1, 2, 3]
+        assert len(provider.non_terminated_nodes()) >= 1
+        assert any("scale-up" in e for e in scaler.events)
+
+        # drain: nothing queued -> idle timeout reclaims the node
+        deadline = time.time() + 60
+        while provider.non_terminated_nodes() and time.time() < deadline:
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes() == []
+        assert any("scale-down" in e for e in scaler.events)
+    finally:
+        scaler.stop()
+        provider.shutdown()
+
+
+def test_request_resources_hint_scales_without_load(cluster):
+    ray, c = cluster
+    provider, scaler = _mk(
+        ray, c, max_workers=1, upscale_delay_s=0.3,
+        node_resources={"CPU": 4}, poll_period_s=0.3,
+        idle_timeout_s=3600,
+    )
+    scaler.start()
+    try:
+        scaler.request_resources([{"CPU": 4}])  # no node fits 4 CPUs yet
+        deadline = time.time() + 30
+        while not provider.non_terminated_nodes() and time.time() < deadline:
+            time.sleep(0.3)
+        assert len(provider.non_terminated_nodes()) == 1
+        c.wait_for_nodes(2, timeout=30)
+        # the hint is now satisfiable -> no further scale-up (max_workers=1)
+        assert ray.get(
+            ray_remote_cpu4(ray).remote(), timeout=60
+        ) == "ok"
+    finally:
+        scaler.stop()
+        provider.shutdown()
+
+
+def ray_remote_cpu4(ray):
+    @ray.remote(num_cpus=4)
+    def probe():
+        return "ok"
+
+    return probe
