@@ -1,0 +1,5 @@
+"""Dashboard: HTTP observability over GCS state (parity: dashboard/)."""
+
+from ray_tpu.dashboard.app import Dashboard, start_dashboard
+
+__all__ = ["Dashboard", "start_dashboard"]

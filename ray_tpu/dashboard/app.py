@@ -1,0 +1,192 @@
+"""Dashboard: cluster observability over HTTP.
+
+Parity: dashboard/ (the reference's aiohttp app + head modules). Compact
+TPU-native take: one asyncio HTTP server that proxies the GCS tables as JSON
+(/api/*) and serves a self-contained HTML page that renders them. No
+external web framework — stdlib asyncio + the framework's own RPC client.
+
+    from ray_tpu.dashboard import start_dashboard
+    url = start_dashboard(gcs_address)          # http://127.0.0.1:8265
+
+CLI: `ray-tpu dashboard --address host:port`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import rpc
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; background: #fafafa; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; width: 100%; background: #fff; }
+ th, td { border: 1px solid #ddd; padding: 4px 8px; font-size: 0.85rem;
+          text-align: left; }
+ th { background: #f0f0f0; }
+ .dead { color: #b00; } .alive { color: #080; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+async function j(p) { return (await fetch(p)).json(); }
+function render(tbl, rows, cols) {
+  const t = document.getElementById(tbl);
+  t.innerHTML = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c => `<td>${r[c] ?? ""}</td>`).join("")
+    + "</tr>").join("");
+}
+async function refresh() {
+  const c = await j("/api/cluster");
+  document.getElementById("cluster").textContent =
+    `resources: ${JSON.stringify(c.total)}  available: ` +
+    `${JSON.stringify(c.available)}  metrics: ${JSON.stringify(c.metrics)}`;
+  render("nodes", await j("/api/nodes"),
+         ["NodeID", "NodeManagerAddress", "Alive", "Resources", "Available"]);
+  render("actors", await j("/api/actors"),
+         ["actor_id", "state", "name", "node_id", "num_restarts"]);
+  render("tasks", (await j("/api/tasks")).slice(-50).reverse(),
+         ["task_id", "name", "state", "worker", "time"]);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class Dashboard:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        self.gcs_address = gcs_address
+        self.host = host
+        self.port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._gcs: Optional[rpc.Connection] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.url: Optional[str] = None
+
+    # -------------------------------------------------------------- server
+    async def _gcs_call(self, method: str, **kw) -> Any:
+        if self._gcs is None or self._gcs.closed:
+            self._gcs = await rpc.connect(self.gcs_address, name="dashboard")
+        return await self._gcs.call(method, timeout=20, **kw)
+
+    async def _route(self, path: str) -> Any:
+        if path == "/api/nodes":
+            out = await self._gcs_call("get_nodes")
+            for n in out:
+                n["Resources"] = json.dumps(n.get("Resources", {}))
+                n["Available"] = json.dumps(n.get("Available", {}))
+            return out
+        if path == "/api/actors":
+            out = await self._gcs_call("list_actors")
+            for a in out:
+                if isinstance(a.get("actor_id"), bytes):
+                    a["actor_id"] = a["actor_id"].hex()[:12]
+            return out
+        if path == "/api/tasks":
+            return await self._gcs_call("list_tasks", limit=500)
+        if path == "/api/cluster":
+            view = await self._gcs_call("get_resource_view")
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for n in view.values():
+                if not n.get("alive"):
+                    continue
+                for k, v in n["total"].items():
+                    total[k] = total.get(k, 0) + v
+                for k, v in n["available"].items():
+                    avail[k] = avail.get(k, 0) + v
+            metrics = await self._gcs_call("get_metrics")
+            return {"total": total, "available": avail, "metrics": metrics}
+        if path == "/api/load":
+            return await self._gcs_call("get_cluster_load")
+        return None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter):
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=10)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.decode("latin1").split()
+            path = parts[1] if len(parts) > 1 else "/"
+            if path == "/" or path.startswith("/index"):
+                body = _PAGE.encode()
+                ctype = "text/html; charset=utf-8"
+                status = "200 OK"
+            else:
+                data = await self._route(path)
+                if data is None:
+                    body, ctype, status = b"not found", "text/plain", "404 Not Found"
+                else:
+                    body = json.dumps(data, default=str).encode()
+                    ctype, status = "application/json", "200 OK"
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+        except Exception:  # noqa: BLE001 - one bad request must not kill it
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _start_async(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.url = f"http://{self.host}:{self.port}"
+
+    def start(self) -> str:
+        """Run the dashboard on a background thread; returns the URL."""
+        started = threading.Event()
+        err: list = []
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._start_async())
+            except BaseException as e:  # noqa: BLE001 - surface bind errors
+                err.append(e)
+                started.set()
+                return
+            started.set()
+            self._loop.run_forever()
+
+        threading.Thread(target=run, daemon=True, name="dashboard").start()
+        if not started.wait(timeout=10):
+            raise RuntimeError("dashboard failed to start")
+        if err:
+            raise err[0]
+        return self.url
+
+    def stop(self) -> None:
+        if self._loop:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def start_dashboard(gcs_address: str, host: str = "127.0.0.1",
+                    port: int = 0) -> Dashboard:
+    d = Dashboard(gcs_address, host=host, port=port or 8265)
+    try:
+        d.start()
+    except OSError:
+        d = Dashboard(gcs_address, host=host, port=0)  # port taken: ephemeral
+        d.start()
+    return d

@@ -118,6 +118,23 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    import time as _time
+
+    from ray_tpu.core.cluster_backend import load_cluster_token
+    from ray_tpu.dashboard import start_dashboard
+
+    load_cluster_token(args.address)
+    dash = start_dashboard(args.address, port=args.port)
+    print(f"dashboard at {dash.url}")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu.microbenchmark import main as bench_main
 
@@ -183,6 +200,11 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("microbenchmark", help="core op/s microbenchmarks")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--address", required=True, help="GCS address host:port")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     job = sub.add_parser("job", help="job submission")
     jsub = job.add_subparsers(dest="job_command", required=True)
