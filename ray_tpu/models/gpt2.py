@@ -49,6 +49,11 @@ class GPT2Config:
     remat: Any = False
     attention_impl: str = "auto"  # auto | xla | pallas | ring
     use_bias: bool = True
+    # scan over layers (True: compact HLO, one traced block) vs an unrolled
+    # Python loop (False: 12x the HLO, but no lax.scan slice/stack traffic —
+    # the profiler showed ~15% of the v5e step in dynamic-update-slice
+    # fusions moving stacked layer params/grads through the scan carry)
+    scan_layers: bool = True
     # When > 0, cross-entropy is computed in sequence chunks of this size
     # (scan + rematerialized chunk logits): the full [B, S, V] f32 logits
     # tensor (3.3 GB at GPT-2-124M batch 16) never exists in HBM. Off by
@@ -267,10 +272,15 @@ def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Ar
     elif cfg.remat:
         block_fn = jax.checkpoint(block_fn, static_argnums=())
 
-    def scan_body(x, layer_params):
-        return block_fn(x, layer_params), None
+    if cfg.scan_layers:
+        def scan_body(x, layer_params):
+            return block_fn(x, layer_params), None
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
+        x, _ = lax.scan(scan_body, x, params["blocks"])
+    else:
+        for i in range(cfg.n_layer):
+            layer = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
+            x = block_fn(x, layer)
     return _layernorm(x, params["lnf_scale"], params["lnf_bias"])
 
 

@@ -65,7 +65,9 @@ def _fwd_kernel(
     *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
 ):
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :]
+    # fold the softmax scale into q once — a per-block [bq, bk] f32 multiply
+    # otherwise rides every inner iteration
+    q = q_ref[0, 0, :, :] * jnp.asarray(scale, q_ref.dtype)
     hd = q.shape[-1]
     q_global = q_off_ref[0] + qi * block_q
 
@@ -80,36 +82,45 @@ def _fwd_kernel(
         num_blocks = jnp.clip(
             (last_q - kv_off_ref[0]) // block_k + 1, 0, nk
         )
+        # blocks whose last column <= the FIRST query row need no mask; only
+        # the diagonal-straddling tail pays the iota/select work
+        num_full = jnp.clip((q_global - kv_off_ref[0] + 1) // block_k, 0, nk)
     else:
         num_blocks = nk
+        num_full = nk
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            rows = q_global + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
+    def make_body(masked):
+        def body(ki, carry):
+            m, l, acc = carry
+            k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+            if masked:
+                rows = q_global + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+            acc = acc * alpha + lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
-        acc = acc * alpha + lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l, acc
+            return m_new, l, acc
+        return body
 
-    m, l, acc = lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    carry = lax.fori_loop(0, num_full, make_body(False), (m0, l0, acc0))
+    m, l, acc = lax.fori_loop(
+        num_full, num_blocks, make_body(causal), carry
+    )
     # rows with no valid kv (ring attention future chunks): l == 0 → output 0,
     # lse = -inf-ish so the ring merge gives them zero weight.
     l_safe = jnp.where(l > 0, l, 1.0)
@@ -172,7 +183,7 @@ def _dq_kernel(
     *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
 ):
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :]
+    q = q_ref[0, 0, :, :] * jnp.asarray(scale, q_ref.dtype)  # fold softmax scale
     do = do_ref[0, 0, :, :]
     lse = lse_ref[0, 0, 0, :][:, None]       # [bq, 1]
     delta = delta_ref[0, 0, 0, :][:, None]   # [bq, 1]
@@ -183,40 +194,45 @@ def _dq_kernel(
     if causal:
         last_q = q_global + block_q - 1
         num_blocks = jnp.clip((last_q - kv_off_ref[0]) // block_k + 1, 0, nk)
+        num_full = jnp.clip((q_global - kv_off_ref[0] + 1) // block_k, 0, nk)
     else:
         num_blocks = nk
+        num_full = nk
 
-    def body(ki, dq):
-        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            rows = q_global + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
+    def make_body(masked):
+        def body(ki, dq):
+            k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+            v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+            if masked:
+                rows = q_global + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            p = jnp.exp(s - lse)                     # [bq, bk] f32
+            dp = lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)                     # [bq, bk] f32
-        dp = lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale
-        dq = dq + lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dq
+            ds = p * (dp - delta)    # ds*scale hoisted to the final dq
+            dq = dq + lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dq
+        return body
 
     dq = lax.fori_loop(
-        0, num_blocks, body, jnp.zeros((block_q, hd), jnp.float32)
+        0, num_full, make_body(False), jnp.zeros((block_q, hd), jnp.float32)
     )
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    dq = lax.fori_loop(num_full, num_blocks, make_body(causal), dq)
+    dq_ref[0, 0, :, :] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
@@ -236,46 +252,59 @@ def _dkv_kernel(
     if causal:
         # first q block whose global end reaches this kv block's start
         first = jnp.clip((kv_global - q_off_ref[0]) // block_q, 0, nq)
+        # first q block whose FIRST row clears this kv block's last column:
+        # from there on no mask is needed
+        first_full = jnp.clip(
+            -((q_off_ref[0] - kv_global - block_k_ + 1) // block_q), 0, nq
+        )
     else:
         first = 0
+        first_full = 0
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            rows = q_off_ref[0] + qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
+    scale_c = jnp.asarray(scale, q_ref.dtype)
+
+    def make_body(masked):
+        def body(qi, carry):
+            dk, dv = carry
+            # qs carries the softmax scale: s = (q·scale)@k and the dk
+            # accumulation dsᵀ@(q·scale) absorbs ds's hoisted ·scale exactly
+            qs = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] * scale_c
+            do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+            lse = lse_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
+            delta = delta_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
+            s = lax.dot_general(
+                qs, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            cols = kv_global + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+            if masked:
+                rows = q_off_ref[0] + qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                cols = kv_global + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            p = jnp.exp(s - lse)                     # [bq, bk]
+            dv = dv + lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)                     # [bq, bk]
-        dv = dv + lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale
-        dk = dk + lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk, dv
+            dp = lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            dk = dk + lax.dot_general(
+                ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk, dv
+        return body
 
     dk0 = jnp.zeros((block_k_, hd), jnp.float32)
     dv0 = jnp.zeros((block_k_, hd), jnp.float32)
-    dk, dv = lax.fori_loop(first, nq, body, (dk0, dv0))
+    carry = lax.fori_loop(first, first_full, make_body(causal), (dk0, dv0))
+    dk, dv = lax.fori_loop(first_full, nq, make_body(False), carry)
     dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
