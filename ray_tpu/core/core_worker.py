@@ -390,6 +390,7 @@ class CoreWorker:
                     "pull_object",
                     oid_hex=oid.hex(),
                     source_addr=loc["raylet_addr"],
+                    nbytes=loc.get("nbytes"),
                     timeout=120,
                 )
             except (rpc.RpcError, rpc.ConnectionLost):

@@ -288,10 +288,11 @@ class GcsServer:
 
     # -------------------------------------------------------------- nodes
     async def handle_register_node(
-        self, conn, node_id, address, session, resources, labels=None
+        self, conn, node_id, address, session, resources, labels=None,
+        transfer_port=None,
     ):
         total = ResourceSet(resources)
-        self.nodes[node_id] = NodeInfo(
+        info = NodeInfo(
             node_id=node_id,
             address=address,
             session=session,
@@ -300,6 +301,8 @@ class GcsServer:
             labels=labels or {},
             conn=conn,
         )
+        info.transfer_port = transfer_port  # native data-plane daemon
+        self.nodes[node_id] = info
         conn.node_id = node_id
         await self.publish("node", {"event": "added", "node": self.nodes[node_id].public()})
         return {"node_id": node_id, "num_nodes": len(self.nodes)}
@@ -345,6 +348,7 @@ class GcsServer:
                 "alive": n.alive,
                 "address": n.address,
                 "session": n.session,
+                "transfer_port": getattr(n, "transfer_port", None),
             }
             for n in self.nodes.values()
         }

@@ -88,6 +88,9 @@ class Raylet:
         self._bg: List[asyncio.Task] = []
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._actor_specs: Dict[bytes, bytes] = {}
+        self.transfer = None               # native data-plane daemon
+        self.transfer_port: Optional[int] = None
+        self._native_pulls = 0
         # actor_id → (release token from _acquire_for-style accounting, demand)
         self._actor_resources: Dict[bytes, Tuple[object, ResourceSet]] = {}
 
@@ -99,6 +102,19 @@ class Raylet:
             env=self.worker_env,
         )
         self.pool.on_worker_death = self._on_worker_death
+        # native data plane: sendfile daemon serving this node's shm dir
+        # (None → peers fall back to the RPC fetch path). start() may compile
+        # the daemon (g++, up to ~2 min cold) — keep it off the event loop.
+        from ray_tpu.core.object_store import native as native_mod
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        self.transfer = native_mod.TransferServer(
+            session_dir(self.session), rpc.get_auth_token() or "none",
+            bind_host=self.server.host,
+        )
+        self.transfer_port = await asyncio.get_event_loop().run_in_executor(
+            None, self.transfer.start
+        )
         self.gcs = await rpc.connect(
             self.gcs_address, handler=self, name=f"raylet-{self.node_id}->gcs"
         )
@@ -109,6 +125,7 @@ class Raylet:
             session=self.session,
             resources=self.total.to_dict(),
             labels=self._labels(),
+            transfer_port=self.transfer_port,
         )
         self._bg.append(asyncio.create_task(self._report_loop()))
         self._bg.append(asyncio.create_task(self._poll_loop()))
@@ -132,6 +149,8 @@ class Raylet:
     async def close(self):
         for t in self._bg:
             t.cancel()
+        if getattr(self, "transfer", None):
+            self.transfer.stop()
         if self.pool:
             self.pool.shutdown()
         if self.gcs:
@@ -175,6 +194,7 @@ class Raylet:
             session=self.session,
             resources=self.total.to_dict(),
             labels=self._labels(),
+            transfer_port=getattr(self, "transfer_port", None),
         )
         logger.warning("re-registered with GCS at %s", self.gcs_address)
 
@@ -509,13 +529,23 @@ class Raylet:
         buf.close()
         return data
 
-    async def handle_pull_object(self, conn, oid_hex, source_addr):
-        """Pull an object from a remote raylet into the local store (parity:
-        PullManager/PushManager chunked transfer — single-frame here)."""
+    async def handle_pull_object(self, conn, oid_hex, source_addr,
+                                 nbytes=None):
+        """Pull an object from a remote raylet into the local store.
+
+        Parity: PullManager/PushManager. Bulk bytes prefer the NATIVE data
+        plane — the peer's sendfile daemon streams the sealed shm file
+        directly into ours, bypassing the asyncio+pickle RPC path entirely
+        (src/ray/object_manager's C++ role). Falls back to the RPC fetch
+        when the peer runs without the native daemon."""
         oid = ObjectID.from_hex(oid_hex)
         if self.shm.contains(oid):
             return True
         if self.directory.restore(oid):
+            return True
+        n = await self._native_pull(oid, oid_hex, source_addr, nbytes)
+        if n is not None:
+            self.directory.add(oid, n)
             return True
         peer = self._peer_conns.get(source_addr)
         if peer is None or peer.closed:
@@ -534,6 +564,35 @@ class Raylet:
         self.shm.put_bytes(oid, data)
         self.directory.add(oid, len(data))
         return True
+
+    async def _native_pull(self, oid, oid_hex: str, source_addr: str,
+                           nbytes=None):
+        """Stream via the peer's sendfile daemon; returns byte count or
+        None (daemon unknown/unreachable → caller falls back to RPC)."""
+        port = None
+        for v in self.cluster_view.values():
+            if v.get("address") == source_addr:
+                if not v.get("alive"):
+                    return None
+                port = v.get("transfer_port")
+                break
+        if not port:
+            return None
+        if nbytes and not self.directory.ensure_capacity(nbytes):
+            return None  # store full even after eviction
+        from ray_tpu.core.object_store import native as native_mod
+
+        host = source_addr.rsplit(":", 1)[0]
+        dest = self.shm._path(oid)
+        token = rpc.get_auth_token() or "none"
+        n = await asyncio.get_event_loop().run_in_executor(
+            None, native_mod.fetch_to_file, host, port, token, oid_hex, dest,
+        )
+        if n is not None:
+            if not nbytes:
+                self.directory.ensure_capacity(n)
+            self._native_pulls += 1
+        return n
 
     def handle_object_store_stats(self, conn):
         return self.directory.stats()

@@ -1,0 +1,86 @@
+"""Native object-transfer data plane (C++ sendfile daemon).
+
+Parity: src/ray/object_manager/ — bulk object bytes move node-to-node
+through the native daemon, not the Python RPC plane. The two raylets here
+get SEPARATE shm sessions (real multi-host has no shared /dev/shm), so the
+driver's get() must stream the object across through the daemon.
+"""
+
+import os
+import shutil
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.object_store import native
+
+
+def test_daemon_builds():
+    assert native.build_transfer_server() is not None, "g++ toolchain expected"
+
+
+@pytest.fixture
+def split_session_cluster():
+    import ray_tpu
+    from ray_tpu.core.cluster_backend import (
+        ProcessGroup,
+        _session_tmp_dir,
+        start_gcs,
+        start_raylet,
+    )
+
+    ray_tpu.shutdown()
+    session_a = f"s{uuid.uuid4().hex[:10]}"
+    session_b = f"s{uuid.uuid4().hex[:10]}"
+    procs = ProcessGroup(_session_tmp_dir(session_a))
+    gcs = start_gcs(procs)
+    start_raylet(procs, gcs, session_a, "node-a", num_cpus=1, num_tpus=0)
+    start_raylet(procs, gcs, session_b, "node-b", num_cpus=1, num_tpus=0,
+                 resources={"b": 1})
+    # pin the driver to node-a's raylet/session — the producing task runs on
+    # node-b (different session), forcing a genuine cross-node transfer
+    ray_tpu.init(address=gcs, _node_name="node-a")
+    try:
+        yield ray_tpu, gcs
+    finally:
+        ray_tpu.shutdown()
+        procs.shutdown()
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        for s in (session_a, session_b):
+            shutil.rmtree(session_dir(s), ignore_errors=True)
+
+
+def test_cross_session_get_streams_through_native_daemon(split_session_cluster):
+    ray, gcs = split_session_cluster
+    ray.nodes()  # ensure registered
+
+    @ray.remote(resources={"b": 1})
+    def produce():
+        return np.full(2_000_000, 9.0)  # 16 MB -> shm on node B
+
+    ref = produce.remote()
+    got = ray.get(ref, timeout=120)
+    assert got.shape == (2_000_000,) and got[0] == 9.0
+
+    # the bytes crossed through node B's native daemon
+    from ray_tpu.api import _global_worker
+    from ray_tpu.core import rpc as rpc_mod
+
+    core = _global_worker().backend.core
+
+    async def view():
+        return await core.gcs.call("get_resource_view", timeout=30)
+
+    nodes = core.io.run(view())
+    served = None
+    for v in nodes.values():
+        p = v.get("transfer_port")
+        if not p:
+            continue
+        st = native.stat("127.0.0.1", p, rpc_mod.get_auth_token() or "none")
+        if st and st[1] > 0:
+            served = st
+    assert served is not None and served[1] >= 16_000_000, served
