@@ -54,6 +54,13 @@ class GPT2Config:
     # the profiler showed ~15% of the v5e step in dynamic-update-slice
     # fusions moving stacked layer params/grads through the scan carry)
     scan_layers: bool = True
+    # mixture-of-experts MLP (ops/moe.py): 0 = dense. When > 0 every block's
+    # MLP becomes E experts with top-k routing; expert params shard over the
+    # mesh's ep axis. aux (load-balance) loss joins the training loss.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01
     # When > 0, cross-entropy is computed in sequence chunks of this size
     # (scan + rematerialized chunk logits): the full [B, S, V] f32 logits
     # tensor (3.3 GB at GPT-2-124M batch 16) never exists in HBM. Off by
@@ -74,6 +81,16 @@ class GPT2Config:
             raise ValueError(
                 f"remat must be True, False, or 'dots'; got {self.remat!r}"
             )
+        if self.moe_experts < 0:
+            raise ValueError("moe_experts must be >= 0")
+        if self.moe_experts > 0:
+            if not (1 <= self.moe_top_k <= self.moe_experts):
+                raise ValueError(
+                    f"moe_top_k={self.moe_top_k} must be in "
+                    f"[1, moe_experts={self.moe_experts}]"
+                )
+            if self.moe_capacity_factor <= 0:
+                raise ValueError("moe_capacity_factor must be > 0")
         if self.loss_chunk and self.seq_len % self.loss_chunk:
             raise ValueError(
                 f"loss_chunk={self.loss_chunk} must divide seq_len="
@@ -132,6 +149,12 @@ def logical_axes(cfg: GPT2Config) -> Dict[str, Any]:
         "out_w": ("layers", "mlp", "embed"),
         "out_b": ("layers", "embed"),
     }
+    if cfg.moe_experts > 0:
+        from ray_tpu.ops.moe import moe_logical_axes
+
+        for key in ("fc_w", "fc_b", "out_w", "out_b"):
+            del blocks[key]
+        blocks["moe"] = moe_logical_axes()
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
@@ -167,6 +190,17 @@ def init(cfg: GPT2Config, rng: jax.Array) -> Dict[str, Any]:
         "out_w": normal(next(k), (L, F, D), resid_std),
         "out_b": jnp.zeros((L, D), pd),
     }
+    if cfg.moe_experts > 0:
+        from ray_tpu.ops.moe import moe_init
+
+        # the dense MLP is replaced wholesale: drop its params so optimizer
+        # state, sharding, and param_count stay honest
+        for key in ("fc_w", "fc_b", "out_w", "out_b"):
+            del blocks[key]
+        blocks["moe"] = moe_init(
+            next(k), L, D, F, cfg.moe_experts, param_dtype=pd,
+            resid_std=resid_std,
+        )
     return {
         "wte": normal(next(k), (V, D), std),
         "wpe": normal(next(k), (S, D), 0.01),
@@ -242,7 +276,11 @@ def _attention(q, k, v, cfg: GPT2Config):
 
 
 def _block(x, layer_params, cfg: GPT2Config):
-    """One transformer block. x: [B, S, D]."""
+    """One transformer block. x: [B, S, D] (or (x, aux) when MoE is on —
+    the load-balance loss accumulates through the layer carry)."""
+    aux_in = None
+    if isinstance(x, tuple):
+        x, aux_in = x
     p = layer_params
     dt = cfg.dtype
     h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
@@ -251,10 +289,19 @@ def _block(x, layer_params, cfg: GPT2Config):
     attn = _attention(q, k, v, cfg)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, p["proj_w"].astype(dt)) + p["proj_b"].astype(dt)
     h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
+    if cfg.moe_experts > 0:
+        from ray_tpu.ops.moe import moe_mlp
+
+        y, aux = moe_mlp(
+            h, p["moe"], top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor, dtype=dt,
+        )
+        x = x + y
+        return (x, (aux_in if aux_in is not None else 0.0) + aux)
     h = jnp.einsum("bsd,df->bsf", h, p["fc_w"].astype(dt)) + p["fc_b"].astype(dt)
     h = jax.nn.gelu(h, approximate=True)
     x = x + jnp.einsum("bsf,fd->bsd", h, p["out_w"].astype(dt)) + p["out_b"].astype(dt)
-    return x
+    return x if aux_in is None else (x, aux_in)
 
 
 def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
@@ -272,6 +319,8 @@ def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Ar
     elif cfg.remat:
         block_fn = jax.checkpoint(block_fn, static_argnums=())
 
+    if cfg.moe_experts > 0:
+        x = (x, jnp.zeros((), jnp.float32))  # thread the aux loss
     if cfg.scan_layers:
         def scan_body(x, layer_params):
             return block_fn(x, layer_params), None
@@ -281,12 +330,15 @@ def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Ar
         for i in range(cfg.n_layer):
             layer = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
             x = block_fn(x, layer)
-    return _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.moe_experts > 0:
+        x, aux = x
+    return _layernorm(x, params["lnf_scale"], params["lnf_bias"]), aux
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     """tokens [B, S] int32 → logits [B, S, padded_vocab] (compute dtype)."""
-    x = _trunk(params, tokens, cfg)
+    x, _ = _trunk(params, tokens, cfg)
     # tied LM head
     return jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(cfg.dtype))
 
@@ -316,7 +368,8 @@ def loss_fn(
     path (tests/test_gpt2_model.py asserts equality).
     """
     B, S = tokens.shape
-    x = _trunk(params, tokens, cfg)
+    x, moe_aux = _trunk(params, tokens, cfg)
+    aux_term = cfg.moe_aux_coeff * moe_aux
     wte = params["wte"].astype(cfg.dtype)
     chunk = cfg.loss_chunk or 0
     # chunk is validated against cfg.seq_len at config time; S % chunk can
@@ -328,7 +381,7 @@ def loss_fn(
         mask = targets >= 0
         safe = jnp.where(mask, targets, 0)
         nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1) + aux_term
 
     xc = x.reshape(B, S // chunk, chunk, -1).swapaxes(0, 1)       # [n, B, c, D]
     tc = targets.reshape(B, S // chunk, chunk).swapaxes(0, 1)     # [n, B, c]
@@ -343,7 +396,7 @@ def loss_fn(
         scan_body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
         (xc, tc),
     )
-    return total / jnp.maximum(count, 1)
+    return total / jnp.maximum(count, 1) + aux_term
 
 
 def flops_per_token(cfg: GPT2Config) -> float:

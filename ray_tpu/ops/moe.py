@@ -1,0 +1,135 @@
+"""Mixture-of-experts MLP with capacity-based einsum dispatch.
+
+TPU-native expert parallelism (SURVEY §2.10; the reference has no TPU MoE —
+this is new work in the GShard/Switch style): the router's top-k choices are
+turned into STATIC-shaped dispatch/combine tensors, so the whole layer is
+three einsums + a batched expert matmul pair. No dynamic shapes, no
+gather/scatter — XLA tiles everything onto the MXU, and the expert dimension
+shards over the mesh's `ep` axis (each device holds E/ep experts; the
+dispatch einsum becomes an all-to-all that XLA inserts from the shardings).
+
+Shapes (T = B*S tokens, E experts, C capacity slots per expert):
+    router_w   [D, E]
+    fc_w       [E, D, F]    fc_b  [E, F]
+    out_w      [E, F, D]    out_b [E, D]
+    dispatch   [T, E, C]  one-hot: token t occupies slot c of expert e
+    combine    [T, E, C]  dispatch * gate weight
+
+Tokens over an expert's capacity are DROPPED (standard GShard semantics:
+the residual connection carries them through unchanged); capacity_factor
+sizes C = ceil(k * T / E) * capacity_factor.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_capacity(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    return max(
+        1, int(math.ceil(top_k * num_tokens / num_experts * capacity_factor))
+    )
+
+
+def moe_init(rng: jax.Array, num_layers: int, d_model: int, d_ff: int,
+             num_experts: int, param_dtype=jnp.float32,
+             resid_std: float = 0.02) -> Dict[str, Any]:
+    """Per-layer stacked expert params ([L, E, ...], matching blocks)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    std = 0.02
+
+    def normal(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(param_dtype)
+
+    L, D, F, E = num_layers, d_model, d_ff, num_experts
+    return {
+        "router_w": normal(k1, (L, D, E), std),
+        "fc_w": normal(k2, (L, E, D, F), std),
+        "fc_b": jnp.zeros((L, E, F), param_dtype),
+        "out_w": normal(k3, (L, E, F, D), resid_std),
+        "out_b": jnp.zeros((L, E, D), param_dtype),
+    }
+
+
+def moe_logical_axes() -> Dict[str, Any]:
+    """Logical axes for one layer-stacked MoE param tree: the `expert` axis
+    maps to the mesh's ep dimension (sharding rules in parallel/mesh)."""
+    return {
+        "router_w": ("layers", "embed", None),
+        "fc_w": ("layers", "expert", "embed", "mlp"),
+        "fc_b": ("layers", "expert", "mlp"),
+        "out_w": ("layers", "expert", "mlp", "embed"),
+        "out_b": ("layers", "expert", "embed"),
+    }
+
+
+def moe_mlp(x: jax.Array, params: Dict[str, Any], *, top_k: int,
+            capacity_factor: float = 1.25, dtype=jnp.bfloat16):
+    """x: [B, S, D] → ([B, S, D], aux_loss scalar).
+
+    params hold ONE layer's tensors (no leading L): router_w [D,E],
+    fc_w [E,D,F], fc_b [E,F], out_w [E,F,D], out_b [E,D].
+    aux_loss is the standard load-balancing loss (mean fraction * mean
+    router prob per expert, scaled by E) — add it to the model loss.
+    """
+    B, S, D = x.shape
+    T = B * S
+    E = params["router_w"].shape[-1]
+    C = moe_capacity(T, E, top_k, capacity_factor)
+    xt = x.reshape(T, D)
+
+    # --- routing (f32 for a stable softmax)
+    logits = jnp.einsum(
+        "td,de->te", xt.astype(jnp.float32),
+        params["router_w"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)                   # [T, E]
+    gate_vals, gate_idx = lax.top_k(probs, top_k)             # [T, k]
+    # renormalize the chosen gates so they sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # --- capacity assignment: position of each (token, choice) within its
+    # expert, computed with a cumulative sum over the one-hot choice matrix
+    # (static shapes end to end)
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)   # [T, k, E]
+    # GShard priority: ALL tokens' 1st choices outrank any 2nd choice —
+    # cumsum in k-major order so capacity pressure degrades to top-1
+    # routing instead of early tokens' spillover evicting later tokens
+    flat = onehot.swapaxes(0, 1).reshape(top_k * T, E)        # k-major
+    position = jnp.cumsum(flat, axis=0) - flat                # [k*T, E]
+    pos_in_expert = jnp.sum(position * flat, axis=-1)         # [k*T]
+    keep = (pos_in_expert < C).astype(jnp.float32)
+    pos = pos_in_expert.reshape(top_k, T).swapaxes(0, 1)      # [T, k]
+    keep = keep.reshape(top_k, T).swapaxes(0, 1)
+
+    slot_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)   # [T, k, C]
+    # dispatch[t,e,c] = 1 iff token t's kept choice routes to (e, c)
+    dispatch = jnp.einsum(
+        "tke,tkc->tec", onehot * keep[..., None], slot_onehot
+    )
+    combine = jnp.einsum(
+        "tke,tkc->tec", onehot * (gate_vals * keep)[..., None], slot_onehot
+    )
+
+    # --- expert compute: batched over E (shardable on the ep mesh axis)
+    xin = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xt.astype(dtype))
+    h = jnp.einsum("ecd,edf->ecf", xin, params["fc_w"].astype(dtype))
+    h = h + params["fc_b"].astype(dtype)[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    out = jnp.einsum("ecf,efd->ecd", h, params["out_w"].astype(dtype))
+    out = out + params["out_b"].astype(dtype)[:, None, :]
+    y = jnp.einsum("tec,ecd->td", combine.astype(dtype), out)
+
+    # --- load-balancing aux loss (Switch Transformer eq. 4)
+    frac_tokens = jnp.mean(onehot[:, 0, :], axis=0)           # top-1 share
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return y.reshape(B, S, D), aux.astype(jnp.float32)

@@ -1,0 +1,101 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Parity: python/ray/util/actor_pool.py — submit/get_next(_unordered)/map
+semantics, including pushing new idle actors into a live pool.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    # ------------------------------------------------------------- submit
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued if every actor is busy."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    # -------------------------------------------------------------- fetch
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        # skip indexes already consumed by get_next_unordered
+        while (self._next_return_index not in self._index_to_future
+                and self._next_return_index < self._next_task_index):
+            self._next_return_index += 1
+        ref = self._index_to_future[self._next_return_index]
+        value = self._ray.get(ref, timeout=timeout)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(ref)
+        self._return_actor(actor)
+        return value
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Whichever pending result lands first."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ready, _ = self._ray.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        idx, actor = self._future_to_actor.pop(ref)
+        del self._index_to_future[idx]
+        self._return_actor(actor)
+        return self._ray.get(ref)
+
+    # ---------------------------------------------------------------- map
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ------------------------------------------------------------ plumbing
+    def push(self, actor: Any) -> None:
+        """Add an idle actor to the pool."""
+        self._return_actor(actor)
+
+    def pop_idle(self) -> Any:
+        """Remove and return an idle actor, or None."""
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,139 @@
+"""multiprocessing.Pool-compatible API over cluster tasks.
+
+Parity: python/ray/util/multiprocessing/pool.py — drop-in Pool with
+map/starmap/imap/imap_unordered/apply(_async), so stdlib-Pool code scales
+past one machine by changing an import.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        done, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(done) == len(self._refs)
+
+
+class Pool:
+    """`processes` caps in-flight submissions for map/imap/imap_unordered
+    (a windowed pipeline, cluster-wide). map_async/starmap submit eagerly —
+    use the iterator forms for very long inputs."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 ray_remote_args: Optional[dict] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes
+        self._remote_args = ray_remote_args or {"num_cpus": 1}
+        self._closed = False
+
+    def _remote(self, fn: Callable):
+        import ray_tpu
+
+        return ray_tpu.remote(**self._remote_args)(fn)
+
+    # ---------------------------------------------------------------- apply
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None) -> Any:
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        self._check_open()
+        ref = self._remote(fn).remote(*args, **(kwds or {}))
+        return AsyncResult([ref], single=True)
+
+    # ------------------------------------------------------------------ map
+    def map(self, fn: Callable, iterable: Iterable[Any]) -> List[Any]:
+        # windowed (honors `processes`) — long inputs don't flood the driver
+        return list(self.imap(fn, iterable))
+
+    def map_async(self, fn: Callable, iterable: Iterable[Any]) -> AsyncResult:
+        self._check_open()
+        rf = self._remote(fn)
+        refs = [rf.remote(x) for x in iterable]
+        return AsyncResult(refs, single=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple]) -> List[Any]:
+        self._check_open()
+        rf = self._remote(fn)
+        import ray_tpu
+
+        return ray_tpu.get([rf.remote(*args) for args in iterable])
+
+    def imap(self, fn: Callable, iterable: Iterable[Any],
+             chunksize: int = 1) -> Iterator[Any]:
+        """Lazy ordered iterator with a bounded submission window."""
+        self._check_open()
+        rf = self._remote(fn)
+        window = max(self._processes or 8, 1)
+        it = iter(iterable)
+        pending: List[Any] = [rf.remote(x) for x in itertools.islice(it, window)]
+        import ray_tpu
+
+        while pending:
+            ref = pending.pop(0)
+            yield ray_tpu.get(ref)
+            for x in itertools.islice(it, 1):
+                pending.append(rf.remote(x))
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable[Any],
+                       chunksize: int = 1) -> Iterator[Any]:
+        self._check_open()
+        rf = self._remote(fn)
+        window = max(self._processes or 8, 1)
+        it = iter(iterable)
+        pending = [rf.remote(x) for x in itertools.islice(it, window)]
+        import ray_tpu
+
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            pending = list(pending)
+            for ref in done:  # wait may surface more than num_returns ready
+                yield ray_tpu.get(ref)
+                for x in itertools.islice(it, 1):
+                    pending.append(rf.remote(x))
+
+    # ------------------------------------------------------------ lifecycle
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

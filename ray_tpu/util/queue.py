@@ -1,0 +1,143 @@
+"""Distributed FIFO queue backed by an actor.
+
+Parity: python/ray/util/queue.py — put/get with block/timeout, qsize,
+empty/full, put_nowait/get_nowait, shared across any process that holds the
+handle (pass the Queue object into tasks/actors).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.items: deque = deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, item: Any) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def put_batch(self, items: List[Any]) -> bool:
+        if self.maxsize > 0 and len(self.items) + len(items) > self.maxsize:
+            return False
+        self.items.extend(items)
+        return True
+
+    def get(self) -> tuple:
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def get_batch(self, n: int) -> List[Any]:
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+
+class Queue:
+    """Create on a driver/worker; pass the object anywhere (it pickles as
+    the actor handle + maxsize)."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None,
+                 _actor=None):
+        import ray_tpu
+
+        self.maxsize = maxsize
+        if _actor is not None:
+            self._actor = _actor
+        else:
+            cls = ray_tpu.remote(**(actor_options or {"num_cpus": 0.1}))(
+                _QueueActor
+            )
+            self._actor = cls.remote(maxsize)
+
+    def __reduce__(self):
+        # reconstruct WITHOUT running __init__'s actor spawn — every
+        # deserialization would otherwise leak one orphan _QueueActor
+        return (Queue._from_actor, (self.maxsize, self._actor))
+
+    @classmethod
+    def _from_actor(cls, maxsize, actor) -> "Queue":
+        return cls(maxsize, _actor=actor)
+
+    # ---------------------------------------------------------------- api
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = ray_tpu.get(self._actor.put.remote(item), timeout=30)
+            if ok:
+                return
+            if not block:
+                raise Full("queue is full")
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full("queue is full (timeout)")
+            time.sleep(0.02)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote(), timeout=30)
+            if ok:
+                return item
+            if not block:
+                raise Empty("queue is empty")
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty("queue is empty (timeout)")
+            time.sleep(0.02)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_batch(self, items: List[Any]) -> None:
+        import ray_tpu
+
+        if not ray_tpu.get(self._actor.put_batch.remote(list(items)),
+                           timeout=30):
+            raise Full("queue cannot fit batch")
+
+    def get_batch(self, n: int) -> List[Any]:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.get_batch.remote(n), timeout=30)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        ray_tpu.kill(self._actor)

@@ -1,0 +1,75 @@
+"""util extras: ActorPool, Queue, multiprocessing.Pool.
+
+Parity: python/ray/util/actor_pool.py, util/queue.py,
+util/multiprocessing/pool.py.
+"""
+
+import pytest
+
+
+@pytest.fixture
+def cluster():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_actor_pool_ordered_and_unordered(cluster):
+    ray = cluster
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray.remote
+    class Doubler:
+        def work(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+    out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+    # submit with every actor busy queues, then drains
+    for v in range(4):
+        pool.submit(lambda a, v: a.work.remote(v), v)
+    got = [pool.get_next() for _ in range(4)]
+    assert got == [0, 2, 4, 6]
+
+
+def test_queue_cross_task(cluster):
+    ray = cluster
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put_batch([2, 3])
+    with pytest.raises(Full):
+        q.put(4, block=False)
+    assert q.qsize() == 3
+
+    @ray.remote
+    def consume(queue):
+        return [queue.get(timeout=10) for _ in range(3)]
+
+    assert ray.get(consume.remote(q), timeout=60) == [1, 2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_multiprocessing_pool(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def sq(x):
+        return x * x
+
+    with Pool(processes=2) as p:
+        assert p.map(sq, range(5)) == [0, 1, 4, 9, 16]
+        assert p.apply(sq, (7,)) == 49
+        assert list(p.imap(sq, range(4))) == [0, 1, 4, 9]
+        assert sorted(p.imap_unordered(sq, range(4))) == [0, 1, 4, 9]
+        r = p.map_async(sq, [3, 4])
+        assert r.get(timeout=60) == [9, 16]
