@@ -135,6 +135,7 @@ class GcsServer:
         self.task_events: "deque" = deque(maxlen=10_000)
         self.metrics: Dict[str, int] = {}
         self._store_dirty = True  # durable-table mutation since last snapshot
+        self._actor_events: Dict[bytes, asyncio.Event] = {}  # get_actor waits
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -553,6 +554,7 @@ class GcsServer:
         info.state = ALIVE
         info.address = address
         info.node_id = node_id
+        self._signal_actor_state(actor_id)
         await self.publish("actor", info.public())
         return True
 
@@ -578,21 +580,43 @@ class GcsServer:
     async def _mark_actor_dead(self, info: ActorInfo, reason: str):
         self._store_dirty = True
         info.state = DEAD
+        self._signal_actor_state(info.actor_id)
         info.death_reason = reason
         info.address = None
         if info.name and self.named_actors.get((info.namespace, info.name)) == info.actor_id:
             del self.named_actors[(info.namespace, info.name)]
         await self.publish("actor", info.public())
 
+    def _actor_event(self, actor_id: bytes) -> asyncio.Event:
+        ev = self._actor_events.get(actor_id)
+        if ev is None:
+            ev = self._actor_events.setdefault(actor_id, asyncio.Event())
+        return ev
+
+    def _signal_actor_state(self, actor_id: bytes) -> None:
+        ev = self._actor_events.pop(actor_id, None)
+        if ev is not None:
+            ev.set()
+
     async def handle_get_actor(self, conn, actor_id, wait_alive=False,
                                wait_timeout=30.0):
         info = self.actors.get(actor_id)
         if info is None:
             return None
-        if wait_alive and info.state in (PENDING, RESTARTING):
-            deadline = time.monotonic() + wait_timeout
-            while info.state in (PENDING, RESTARTING) and time.monotonic() < deadline:
-                await asyncio.sleep(0.02)
+        # event-driven wait (no 20ms polling tick per caller — the reference
+        # pushes actor state via pubsub; weak-#4 fix): state transitions
+        # signal the per-actor event
+        deadline = time.monotonic() + wait_timeout
+        while wait_alive and info.state in (PENDING, RESTARTING):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(
+                    self._actor_event(actor_id).wait(), timeout=remaining
+                )
+            except asyncio.TimeoutError:
+                break
         return info.public()
 
     def handle_get_named_actor(self, conn, name, namespace="default"):

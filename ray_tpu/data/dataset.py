@@ -19,12 +19,15 @@ from ray_tpu.data import datasource as ds_mod
 from ray_tpu.data.block import (
     Block,
     block_concat,
+    block_from_rows,
     block_num_rows,
     block_rows,
     block_slice,
+    block_take,
 )
 from ray_tpu.data.executor import (
     ActorPoolStrategy,
+    FromRefsOp,
     LimitOp,
     MapBatchesOp,
     Op,
@@ -38,6 +41,14 @@ class Dataset:
     def __init__(self, ops: List[Op], materialized_refs: Optional[List[Any]] = None):
         self._ops = ops
         self._materialized = materialized_refs
+
+    def _base_ops(self) -> List[Op]:
+        """Plan prefix for chaining: materialized datasets re-enter the
+        stream through their refs (transforms after union/repartition/sort
+        must not silently drop the data)."""
+        if self._materialized is not None:
+            return [FromRefsOp(list(self._materialized))]
+        return list(self._ops)
 
     # ------------------------------------------------------------ transforms
     def map_batches(
@@ -53,7 +64,7 @@ class Dataset:
         class (constructed once per actor with ActorPoolStrategy compute).
         batch_size=None applies fn per existing block (zero re-chunk cost);
         an explicit batch_size re-chunks the stream first."""
-        ops = list(self._ops)
+        ops = self._base_ops()
         if batch_size is not None:
             ops.append(RechunkOp(batch_size))
         ops.append(MapBatchesOp(fn=fn, compute=compute, fn_args=fn_args,
@@ -78,7 +89,7 @@ class Dataset:
         return self.map_batches(filter_rows)
 
     def limit(self, n: int) -> "Dataset":
-        return Dataset(list(self._ops) + [LimitOp(n)])
+        return Dataset(self._base_ops() + [LimitOp(n)])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Block-local shuffle + shuffled block order (approximate global
@@ -94,6 +105,63 @@ class Dataset:
             return block_take(block, rng.permutation(n))
 
         return self.map_batches(shuffle_block)
+
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        """Each row expands to zero or more rows."""
+        def flat_rows(block: Block) -> Block:
+            out = []
+            for r in block_rows(block):
+                out.extend(fn(r))
+            return block_from_rows(out)
+
+        return self.map_batches(flat_rows)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets. EAGER: executes the upstream plans now and
+        holds block refs (further transforms chain lazily on the refs)."""
+        refs = list(self.materialize().iter_block_refs())
+        for o in others:
+            refs.extend(o.materialize().iter_block_refs())
+        return Dataset([], materialized_refs=refs)
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance into `num_blocks` row-even blocks (EAGER; remote
+        re-cut via the split machinery, no driver materialization)."""
+        shards = self.split(num_blocks)
+        refs = []
+        import ray_tpu
+
+        # refs pass as TOP-LEVEL args so the executing worker resolves them
+        merge = ray_tpu.remote(num_cpus=0.25)(
+            lambda *blocks: block_concat(blocks)
+        )
+        for sh in shards:
+            rs = list(sh.iter_block_refs())
+            refs.append(rs[0] if len(rs) == 1 else merge.remote(*rs))
+        return Dataset([], materialized_refs=refs)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Global sort by a column (parity: Dataset.sort). EAGER: the sorted
+        dataset materializes on the driver (one concat + argsort — works for
+        any comparable dtype including strings); a distributed range-
+        partitioned sort is the scale-up path when blocks outgrow driver
+        RAM."""
+        import ray_tpu
+
+        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
+        blocks = [b for b in blocks if block_num_rows(b) > 0]
+        if not blocks:
+            return Dataset([], materialized_refs=[])
+        whole = block_concat(blocks)
+        order = np.argsort(whole[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        out = block_take(whole, order)
+        return Dataset([], materialized_refs=[ray_tpu.put(out)])
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        return GroupedDataset(self, key)
 
     # ------------------------------------------------------------ execution
     def iter_block_refs(self, **executor_kwargs) -> Iterator[Any]:
@@ -209,6 +277,73 @@ class Dataset:
             return f"MaterializedDataset({len(self._materialized)} blocks)"
         names = [getattr(op, "name", type(op).__name__) for op in self._ops]
         return f"Dataset({' -> '.join(names)})"
+
+
+
+
+class GroupedDataset:
+    """Per-key aggregations (parity: Dataset.groupby().count()/sum()/...).
+
+    Two stages: remote per-block partial aggregates, then a driver-side
+    combine over the (small) partials — full rows never land on the driver.
+    """
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _partials(self, value_col: Optional[str]):
+        import ray_tpu
+
+        key = self._key
+
+        def partial(block: Block):
+            out: Dict[Any, list] = {}
+            ks = block[key]
+            vs = block[value_col] if value_col else None
+            for i in builtins.range(len(ks)):
+                k = ks[i].item() if hasattr(ks[i], "item") else ks[i]
+                e = out.setdefault(k, [0, 0.0, None, None])  # n, sum, min, max
+                e[0] += 1
+                if vs is not None:
+                    v = float(vs[i])
+                    e[1] += v
+                    e[2] = v if e[2] is None else min(e[2], v)
+                    e[3] = v if e[3] is None else max(e[3], v)
+            return out
+
+        run = ray_tpu.remote(num_cpus=0.25)(partial)
+        parts = ray_tpu.get(
+            [run.remote(r) for r in self._ds.iter_block_refs()], timeout=600
+        )
+        combined: Dict[Any, list] = {}
+        for p in parts:
+            for k, (n, s_, mn, mx) in p.items():
+                e = combined.setdefault(k, [0, 0.0, None, None])
+                e[0] += n
+                e[1] += s_
+                if mn is not None:
+                    e[2] = mn if e[2] is None else min(e[2], mn)
+                if mx is not None:
+                    e[3] = mx if e[3] is None else max(e[3], mx)
+        return combined
+
+    def count(self) -> Dict[Any, int]:
+        return {k: e[0] for k, e in self._partials(None).items()}
+
+    def sum(self, col: str) -> Dict[Any, float]:
+        return {k: e[1] for k, e in self._partials(col).items()}
+
+    def mean(self, col: str) -> Dict[Any, float]:
+        return {
+            k: e[1] / e[0] for k, e in self._partials(col).items()
+        }
+
+    def min(self, col: str) -> Dict[Any, float]:
+        return {k: e[2] for k, e in self._partials(col).items()}
+
+    def max(self, col: str) -> Dict[Any, float]:
+        return {k: e[3] for k, e in self._partials(col).items()}
 
 
 # ---------------------------------------------------------------- read API

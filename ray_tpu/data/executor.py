@@ -31,6 +31,14 @@ class ActorPoolStrategy:
 
 # ------------------------------------------------------------------ operators
 @dataclass
+class FromRefsOp:
+    """Source op over already-materialized block refs (chaining transforms
+    after union/repartition/sort/materialize)."""
+
+    refs: list
+
+
+@dataclass
 class ReadOp:
     read_tasks: List[Callable[[], Block]]
     name: str = "Read"
@@ -108,6 +116,8 @@ class StreamingExecutor:
             for op in ops:
                 if isinstance(op, ReadOp):
                     stream = self._read_stream(op)
+                elif isinstance(op, FromRefsOp):
+                    stream = iter(op.refs)
                 elif isinstance(op, MapBatchesOp):
                     stream = self._map_stream(op, stream)
                 elif isinstance(op, LimitOp):

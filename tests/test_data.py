@@ -191,3 +191,47 @@ class TestTrainIntegration:
         all_ranks = result.metrics["_all_ranks"]
         assert set(all_ranks) == {0, 1}
         assert all(m["rows_seen"] == 96 for m in all_ranks.values())
+
+
+def test_flat_map_union_repartition(ray_start_local):
+    rdata = rd
+    ds = rdata.from_items([1, 2, 3]).flat_map(lambda r: [int(r)] * int(r))
+    assert sorted(int(r) for r in ds.take_all()) == [1, 2, 2, 3, 3, 3]
+
+    a = rdata.from_items([1, 2])
+    b = rdata.from_items([3, 4])
+    assert sorted(int(r) for r in a.union(b).take_all()) == [1, 2, 3, 4]
+
+    rp = rdata.range(10, parallelism=5).repartition(2)
+    refs = list(rp.iter_block_refs())
+    assert len(refs) == 2
+    assert sorted(r["id"] for r in rp.take_all()) == list(range(10))
+
+
+def test_sort_and_groupby(ray_start_local):
+    rdata = rd
+    items = [{"k": i % 3, "v": float(i)} for i in range(12)]
+    ds = rdata.from_items(items)
+
+    s = ds.sort("v", descending=True).take_all()
+    assert [r["v"] for r in s] == sorted((float(i) for i in range(12)),
+                                         reverse=True)
+
+    g = ds.groupby("k")
+    assert g.count() == {0: 4, 1: 4, 2: 4}
+    assert g.sum("v") == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    assert g.mean("v")[0] == (0 + 3 + 6 + 9) / 4
+    assert g.min("v") == {0: 0.0, 1: 1.0, 2: 2.0}
+    assert g.max("v") == {0: 9.0, 1: 10.0, 2: 11.0}
+
+
+def test_transforms_chain_after_materialized_ops(ray_start_local):
+    # regression: map after union/sort must not silently drop the data
+    a = rd.from_items([3, 1])
+    b = rd.from_items([2, 4])
+    u = a.union(b).map(lambda r: int(r) * 10)
+    assert sorted(int(r) for r in u.take_all()) == [10, 20, 30, 40]
+
+    s = rd.from_items([{"k": "b"}, {"k": "a"}]).sort("k")
+    assert [r["k"] for r in s.take_all()] == ["a", "b"]
+    assert s.limit(1).take_all()[0]["k"] == "a"
