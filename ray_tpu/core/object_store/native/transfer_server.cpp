@@ -79,10 +79,21 @@ static void handle(int cfd, const std::string& dir, const std::string& token) {
   std::string line(buf);
   size_t sp1 = line.find(' ');
   if (sp1 == std::string::npos) { close(cfd); return; }
-  if (line.substr(0, sp1) != token) {
-    // wrong token: close without a byte (don't oracle)
-    close(cfd);
-    return;
+  // Constant-time token compare (match hmac.compare_digest on the Python
+  // RPC plane): length mismatch still walks the full candidate so timing
+  // doesn't leak a prefix.
+  {
+    std::string cand = line.substr(0, sp1);
+    volatile unsigned char diff = cand.size() == token.size() ? 0 : 1;
+    for (size_t i = 0; i < cand.size(); ++i) {
+      unsigned char t = token.empty() ? 0 : (unsigned char)token[i % token.size()];
+      diff |= (unsigned char)cand[i] ^ t;
+    }
+    if (diff) {
+      // wrong token: close without a byte (don't oracle)
+      close(cfd);
+      return;
+    }
   }
   std::string rest = line.substr(sp1 + 1);
   if (rest == "STAT") {
@@ -112,12 +123,16 @@ static void handle(int cfd, const std::string& dir, const std::string& token) {
   send_all(cfd, hdr, (size_t)hn);
 
   off_t off = 0;
+  int stalls = 0;  // consecutive SNDTIMEO expiries with no forward progress
   while (off < st.st_size) {
     ssize_t s = sendfile(cfd, ffd, &off, (size_t)(st.st_size - off));
-    if (s <= 0) {
-      if (errno == EAGAIN || errno == EINTR) continue;
-      break;
+    if (s == 0) break;  // file shrank under us; errno is stale — bail
+    if (s < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN && ++stalls < 2) continue;
+      break;  // stalled peer: give up after ~2 send-timeout windows
     }
+    stalls = 0;
   }
   if (off == st.st_size) {
     g_objects_served.fetch_add(1);

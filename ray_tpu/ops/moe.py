@@ -110,7 +110,9 @@ def moe_mlp(x: jax.Array, params: Dict[str, Any], *, top_k: int,
     pos = pos_in_expert.reshape(top_k, T).swapaxes(0, 1)      # [T, k]
     keep = keep.reshape(top_k, T).swapaxes(0, 1)
 
-    slot_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)   # [T, k, C]
+    slot_onehot = jax.nn.one_hot(
+        pos.astype(jnp.int32), C, dtype=jnp.float32
+    )                                                         # [T, k, C]
     # dispatch[t,e,c] = 1 iff token t's kept choice routes to (e, c)
     dispatch = jnp.einsum(
         "tke,tkc->tec", onehot * keep[..., None], slot_onehot

@@ -25,6 +25,7 @@ def vtrace_from_logps(
     discounts,
     clip_rho_threshold: float = 1.0,
     clip_c_threshold: float = 1.0,
+    clip_pg_rho_threshold: float | None = None,
 ) -> VTraceReturns:
     """All inputs time-major.
 
@@ -43,6 +44,11 @@ def vtrace_from_logps(
 
     rhos = jnp.exp(target_logp - behavior_logp)
     clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
+    # separate clip for the policy-gradient advantages (reference exposes
+    # clip_pg_rho_threshold; defaults coincide with clip_rho_threshold)
+    if clip_pg_rho_threshold is None:
+        clip_pg_rho_threshold = clip_rho_threshold
+    clipped_pg_rhos = jnp.minimum(rhos, clip_pg_rho_threshold)
     cs = jnp.minimum(rhos, clip_c_threshold)
 
     values_t_plus_1 = jnp.concatenate(
@@ -65,7 +71,7 @@ def vtrace_from_logps(
     vs = values + vs_minus_v
 
     vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
-    pg_advantages = clipped_rhos * (
+    pg_advantages = clipped_pg_rhos * (
         rewards + discounts * vs_t_plus_1 - values
     )
     return VTraceReturns(

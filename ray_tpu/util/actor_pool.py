@@ -1,12 +1,21 @@
 """ActorPool: load-balance tasks over a fixed set of actors.
 
-Parity: python/ray/util/actor_pool.py — submit/get_next(_unordered)/map
-semantics, including pushing new idle actors into a live pool.
+Parity: python/ray/util/actor_pool.py API surface — submit /
+get_next(_unordered) / map(_unordered) / has_next / has_free / push /
+pop_idle semantics, including pushing new idle actors into a live pool.
+
+Implementation is ticket-based: every submission is assigned a
+monotonically increasing ticket, and all in-flight work lives in one
+insertion-ordered map ``ticket -> (ref, actor)``. Ordered consumption
+pops the lowest live ticket; unordered consumption waits on whichever
+ref lands first and retires its ticket, so the two modes compose freely
+on the same pool.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, List
+from collections import deque
+from typing import Any, Callable, Iterable, Iterator
 
 
 class ActorPool:
@@ -14,88 +23,89 @@ class ActorPool:
         import ray_tpu
 
         self._ray = ray_tpu
-        self._idle: List[Any] = list(actors)
-        self._future_to_actor: dict = {}
-        self._index_to_future: dict = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._free: deque = deque(actors)
+        self._backlog: deque = deque()       # (fn, value) waiting for an actor
+        self._ticket_seq = 0
+        # insertion-ordered (dicts preserve order): ticket -> (ref, actor)
+        self._inflight: dict = {}
+        self._ticket_of: dict = {}           # ref -> ticket (reverse lookup)
 
     # ------------------------------------------------------------- submit
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """fn(actor, value) -> ObjectRef; queued if every actor is busy."""
-        if self._idle:
-            actor = self._idle.pop()
-            ref = fn(actor, value)
-            self._future_to_actor[ref] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+        if not self._free:
+            self._backlog.append((fn, value))
+            return
+        actor = self._free.popleft()
+        ref = fn(actor, value)
+        ticket = self._ticket_seq
+        self._ticket_seq += 1
+        self._inflight[ticket] = (ref, actor)
+        self._ticket_of[ref] = ticket
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor)
+        return bool(self._inflight)
 
     def has_free(self) -> bool:
-        return bool(self._idle) and not self._pending_submits
+        return bool(self._free) and not self._backlog
 
     # -------------------------------------------------------------- fetch
-    def _return_actor(self, actor) -> None:
-        self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+    def _recycle(self, actor) -> None:
+        self._free.append(actor)
+        if self._backlog:
+            self.submit(*self._backlog.popleft())
+
+    def _retire(self, ticket: int):
+        ref, actor = self._inflight.pop(ticket)
+        del self._ticket_of[ref]
+        self._recycle(actor)
+        return ref
 
     def get_next(self, timeout: float = None) -> Any:
         """Next result in SUBMISSION order."""
-        if not self.has_next():
+        if not self._inflight:
             raise StopIteration("no pending results")
-        # skip indexes already consumed by get_next_unordered
-        while (self._next_return_index not in self._index_to_future
-                and self._next_return_index < self._next_task_index):
-            self._next_return_index += 1
-        ref = self._index_to_future[self._next_return_index]
-        value = self._ray.get(ref, timeout=timeout)
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(ref)
-        self._return_actor(actor)
-        return value
+        oldest = next(iter(self._inflight))   # lowest live ticket
+        ref, _ = self._inflight[oldest]
+        done, _ = self._ray.wait([ref], num_returns=1, timeout=timeout)
+        if not done:
+            raise TimeoutError("get_next timed out")
+        # retire BEFORE get: a raising task must still recycle its actor
+        self._retire(oldest)
+        return self._ray.get(ref)
 
     def get_next_unordered(self, timeout: float = None) -> Any:
         """Whichever pending result lands first."""
-        if not self.has_next():
+        if not self._inflight:
             raise StopIteration("no pending results")
-        ready, _ = self._ray.wait(
-            list(self._future_to_actor), num_returns=1, timeout=timeout
+        done, _ = self._ray.wait(
+            list(self._ticket_of), num_returns=1, timeout=timeout
         )
-        if not ready:
+        if not done:
             raise TimeoutError("get_next_unordered timed out")
-        ref = ready[0]
-        idx, actor = self._future_to_actor.pop(ref)
-        del self._index_to_future[idx]
-        self._return_actor(actor)
-        return self._ray.get(ref)
+        self._retire(self._ticket_of[done[0]])
+        return self._ray.get(done[0])
 
     # ---------------------------------------------------------------- map
     def map(self, fn: Callable[[Any, Any], Any],
             values: Iterable[Any]) -> Iterator[Any]:
         for v in values:
             self.submit(fn, v)
-        while self.has_next():
+        while self._inflight:
             yield self.get_next()
 
     def map_unordered(self, fn: Callable[[Any, Any], Any],
                       values: Iterable[Any]) -> Iterator[Any]:
         for v in values:
             self.submit(fn, v)
-        while self.has_next():
+        while self._inflight:
             yield self.get_next_unordered()
 
     # ------------------------------------------------------------ plumbing
     def push(self, actor: Any) -> None:
         """Add an idle actor to the pool."""
-        self._return_actor(actor)
+        self._recycle(actor)
 
     def pop_idle(self) -> Any:
         """Remove and return an idle actor, or None."""
-        return self._idle.pop() if self._idle else None
+        return self._free.pop() if self._free else None
