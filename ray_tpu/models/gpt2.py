@@ -61,6 +61,12 @@ class GPT2Config:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coeff: float = 0.01
+    # Pipeline parallelism (parallel/pipeline.py): number of GPipe
+    # microbatches when the active mesh has a pp axis > 1. 0 = auto (one
+    # microbatch per stage — minimum that keeps every stage busy; raise it
+    # to shrink the (pp-1)/(M+pp-1) bubble at the cost of more live
+    # activations). Ignored on pp=1 meshes.
+    pipeline_microbatches: int = 0
     # When > 0, cross-entropy is computed in sequence chunks of this size
     # (scan + rematerialized chunk logits): the full [B, S, V] f32 logits
     # tensor (3.3 GB at GPT-2-124M batch 16) never exists in HBM. Off by
@@ -304,13 +310,7 @@ def _block(x, layer_params, cfg: GPT2Config):
     return x if aux_in is None else (x, aux_in)
 
 
-def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """tokens [B, S] int32 → final hidden states [B, S, D] (compute dtype)."""
-    B, S = tokens.shape
-    dt = cfg.dtype
-    wte = params["wte"].astype(dt)
-    x = wte[tokens] + params["wpe"][:S].astype(dt)
-
+def _make_block_fn(cfg: GPT2Config):
     block_fn = partial(_block, cfg=cfg)
     if cfg.remat == "dots":
         block_fn = jax.checkpoint(
@@ -318,7 +318,61 @@ def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Ar
         )
     elif cfg.remat:
         block_fn = jax.checkpoint(block_fn, static_argnums=())
+    return block_fn
 
+
+def _blocks_pipelined(blocks, x, cfg: GPT2Config, mesh, pp: int):
+    """Run the layer stack as a pp-stage GPipe pipeline (parallel/pipeline)."""
+    from ray_tpu.parallel.pipeline import pipeline_apply, stages_from_layers
+
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "pipeline parallelism with MoE blocks is not supported yet "
+            "(the aux-loss carry needs threading through the schedule); "
+            "use a pp=1 mesh for MoE configs"
+        )
+    if cfg.n_layer % pp:
+        raise ValueError(f"n_layer={cfg.n_layer} not divisible by pp={pp}")
+    M = cfg.pipeline_microbatches or pp
+    block_fn = _make_block_fn(cfg)
+    lpp = cfg.n_layer // pp
+    stage_params = stages_from_layers(blocks, pp)
+
+    def stage_fn(layers, h):
+        if cfg.scan_layers:
+            def body(h, lp):
+                return block_fn(h, lp), None
+
+            h, _ = lax.scan(body, h, layers)
+            return h
+        for i in range(lpp):
+            h = block_fn(h, jax.tree_util.tree_map(lambda p: p[i], layers))
+        return h
+
+    return pipeline_apply(
+        stage_fn, stage_params, x,
+        num_stages=pp, num_microbatches=M, mesh=mesh,
+    )
+
+
+def _trunk(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, S] int32 → final hidden states [B, S, D] (compute dtype)."""
+    from ray_tpu.parallel import mesh as mesh_lib
+
+    B, S = tokens.shape
+    dt = cfg.dtype
+    wte = params["wte"].astype(dt)
+    x = wte[tokens] + params["wpe"][:S].astype(dt)
+
+    mesh = mesh_lib.current_mesh()
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        x = _blocks_pipelined(params["blocks"], x, cfg, mesh, pp)
+        return _layernorm(x, params["lnf_scale"], params["lnf_bias"]), jnp.zeros(
+            (), jnp.float32
+        )
+
+    block_fn = _make_block_fn(cfg)
     if cfg.moe_experts > 0:
         x = (x, jnp.zeros((), jnp.float32))  # thread the aux loss
     if cfg.scan_layers:

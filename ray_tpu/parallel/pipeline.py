@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the mesh's `pp` axis.
+
+The reference has NO pipeline parallelism (SURVEY §2.10: "absent — must be
+built new"; its only model-parallel story was the external Alpa integration,
+release/alpa_tests/). This is new TPU-first work, in the GSPMD style rather
+than the torch send/recv style:
+
+- the L stacked layers are reshaped to [pp, L/pp, ...] and the *stage*
+  dimension is sharded over the mesh's `pp` axis, so each device group holds
+  only its stage's weights;
+- one "tick" of the schedule runs `jax.vmap` of the stage function over the
+  stage dimension — because that dimension is sharded, each device computes
+  exactly its own stage, all stages in parallel on different microbatches;
+- activations advance one stage per tick via `jnp.roll` on the sharded stage
+  dimension, which XLA's SPMD partitioner lowers to a `CollectivePermute` on
+  the ICI ring — the idiomatic-on-TPU equivalent of GPipe's send/recv;
+- the schedule itself is a `lax.scan` over M + pp - 1 ticks (M microbatches
+  fill and drain the pipeline; bubble fraction = (pp-1)/(M+pp-1)).
+
+Everything is ordinary traced JAX: `jax.grad` differentiates straight through
+the scan/roll (the roll transposes to the reverse permute), and the pipeline
+composes with dp/fsdp/tp shardings on the other mesh axes with no manual
+collectives — pp is just one more axis in the sharding rules
+(parallel/sharding.py maps logical "layers" → "pp" for pipelined plans).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import mesh as mesh_lib
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    num_stages: int,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Run `x` through `num_stages` pipeline stages with a GPipe schedule.
+
+    stage_fn:      (stage_layers, h) -> h, applied per stage; stage_layers is
+                   stage_params with the leading stage dim indexed away (by
+                   vmap), h is one microbatch of activations.
+    stage_params:  pytree whose leaves have leading dim `num_stages`.
+    x:             [B, ...] activations, B divisible by num_microbatches.
+
+    Returns [B, ...] — exactly stage_{P-1}(...stage_0(x)...) per microbatch,
+    reassembled in order. When `mesh` (with a `pp` axis) is given, sharding
+    constraints pin the stage dim to `pp` and the microbatch dim to the batch
+    axes so the partitioner keeps weights and activations where they belong.
+    """
+    P_, M = num_stages, num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    mb = B // M
+    rest = x.shape[1:]
+
+    def c_state(t):  # [P, mb, ...]: stage dim on pp, microbatch on batch axes
+        if mesh is None or mesh.shape.get("pp", 1) == 1:
+            return t
+        return lax.with_sharding_constraint(
+            t, NamedSharding(mesh, P("pp", mesh_lib.BATCH_AXES))
+        )
+
+    def c_micro(t):  # [M, mb, ...]: microbatch index replicated, mb on batch
+        if mesh is None:
+            return t
+        return lax.with_sharding_constraint(
+            t, NamedSharding(mesh, P(None, mesh_lib.BATCH_AXES))
+        )
+
+    xm = c_micro(x.reshape((M, mb) + rest))
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+
+    state = c_state(jnp.zeros((P_, mb) + rest, x.dtype))
+    # M live slots + one scratch slot that absorbs the warmup ticks' writes
+    outputs = c_micro(jnp.zeros((M + 1, mb) + rest, x.dtype))
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t while the pipeline is filling
+        feed = lax.dynamic_index_in_dim(xm, jnp.minimum(t, M - 1), 0,
+                                        keepdims=False)
+        state = state.at[0].set(jnp.where(t < M, feed, state[0]))
+        out = c_state(vstage(stage_params, c_state(state)))
+        # the last stage finishes microbatch t-(P-1); warmup ticks land in
+        # the scratch slot M and are discarded
+        out_idx = jnp.where(t >= P_ - 1, t - (P_ - 1), M)
+        outputs = lax.dynamic_update_slice_in_dim(
+            outputs, out[P_ - 1][None], out_idx, 0
+        )
+        # advance: stage s's output becomes stage s+1's input (roll on the
+        # pp-sharded dim == CollectivePermute over the ICI ring); the wrap
+        # into slot 0 is dead — overwritten by the next tick's feed.
+        state = jnp.roll(out, 1, axis=0)
+        return (state, c_micro(outputs)), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(M + P_ - 1)
+    )
+    return outputs[:M].reshape((B,) + rest)
+
+
+def stages_from_layers(layers: Any, num_stages: int) -> Any:
+    """Reshape stacked per-layer params [L, ...] → [P, L/P, ...] (contiguous
+    stage chunks, so a `layers`→`pp` sharding carries over to the stage dim)."""
+    def split(p):
+        L = p.shape[0]
+        if L % num_stages:
+            raise ValueError(
+                f"layer count {L} not divisible by pp={num_stages}"
+            )
+        return p.reshape((num_stages, L // num_stages) + p.shape[1:])
+
+    return jax.tree.map(split, layers)

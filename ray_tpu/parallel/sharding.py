@@ -18,8 +18,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_RULES: Dict[str, Any] = {
     "batch": ("dp", "fsdp"),
     "seq": "cp",
-    "layers": None,          # layers are stacked + scanned, never sharded (pp
-                             # uses stage meshes instead — see parallel/pipeline)
+    "layers": None,          # stacked + scanned on pp=1 meshes; pipelined
+                             # plans override this to "pp" (train_step does it
+                             # automatically) so each stage holds only its own
+                             # layers — see parallel/pipeline.py
     "vocab": "tp",
     "embed": "fsdp",
     "heads": "tp",

@@ -63,6 +63,21 @@ def make_gpt2_train_step(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
+    if mesh.shape.get("pp", 1) > 1:
+        if cfg.moe_experts > 0:
+            raise NotImplementedError(
+                "pipeline parallelism with MoE blocks is not supported yet; "
+                "use a pp=1 mesh for MoE configs"
+            )
+        if cfg.n_layer % mesh.shape["pp"]:
+            raise ValueError(
+                f"n_layer={cfg.n_layer} not divisible by pp={mesh.shape['pp']}"
+            )
+        # pipelined plan: shard the stacked layer dim over pp so each stage
+        # group holds only its own layers (parallel/pipeline.py reshapes
+        # [L, ...] → [pp, L/pp, ...], which preserves this sharding).
+        rules = {"layers": "pp", **(rules or {})}
+
     log_axes = gpt2.logical_axes(cfg)
     param_shardings = sharding_lib.tree_shardings(mesh, log_axes, rules)
 
