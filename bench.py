@@ -53,14 +53,20 @@ def main():
 
     devices = jax.devices()
     n_chips = len(devices)
-    # Config from the round-3 measured sweep + device profile on v5e: the
-    # layer scan spent ~15% of each step in dynamic-update-slice fusions
-    # moving stacked params/grads (scan_layers=False removes them and also
-    # shrinks live memory enough that remat=False fits batch 24), and with
-    # the flash kernel there are no S×S residuals to rematerialize — so no
-    # remat + unrolled layers: 83.5k → 108.2k tok/s/chip (MFU .365 → .472).
-    # Chunked CE re-measured slower (97.4k); blocks 512/512 beat 1024/1024.
-    cfg = gpt2.gpt2_124m(remat=False, scan_layers=False)
+    # Config from the round-3/4 measured sweeps + device profiles on v5e:
+    # - scan_layers=False: the layer scan spent ~15% of each step in
+    #   dynamic-update-slice fusions moving stacked params/grads; unrolling
+    #   removes them and shrinks live memory enough that remat=False fits.
+    # - remat=False: with the flash kernel there are no S×S residuals.
+    # - fused CE (ops/cross_entropy.py): the f32 [B,S,V] log-softmax
+    #   residual was 17 ms/step of pure HBM traffic (r4 profile).
+    # - flash blocks: fwd 256/1024 (whole-row kv → no online-softmax
+    #   rescale chain), bwd 512/512, fused single-pass backward kernel.
+    cfg = gpt2.gpt2_124m(
+        remat=False, scan_layers=False,
+        attn_block_q=256, attn_block_k=1024,
+        attn_bwd_block_q=512, attn_bwd_block_k=512,
+    )
     # fsdp over all local chips (== single-device mesh on one chip) so the
     # per-chip division below is honest on multi-chip hosts.
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec.for_devices(n_chips), devices)
@@ -76,7 +82,13 @@ def main():
     global_batch, state = find_batch(
         bundle.step_fn, state, cfg, candidates=tuple(b * n_chips for b in per_chip)
     )
-    batch = synthetic_batch(cfg, global_batch=global_batch, seed=1)
+    # Device-resident input, as the Train data path delivers it (the
+    # iterator device_puts prefetched batches; see data/iterator.py). A
+    # numpy batch would re-ship 400 KB through the host tunnel every step.
+    batch = jax.device_put(
+        synthetic_batch(cfg, global_batch=global_batch, seed=1),
+        {"tokens": bundle.data_sharding, "targets": bundle.data_sharding},
+    )
 
     # warmup (compile already done in find_batch for this shape). The first
     # ~10 post-compile executions run up to 3x slow on the tunnelled chip

@@ -48,6 +48,14 @@ class GPT2Config:
     #            of the residual memory
     remat: Any = False
     attention_impl: str = "auto"  # auto | xla | pallas | ring
+    # Pallas flash kernel tile sizes (ops/attention.py), forward and
+    # backward separately. 512/512 wins in-model on v5e (1024/1024 is ~15%
+    # faster standalone but loses ~4% inside the full step — VMEM pressure
+    # against neighboring fusions).
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+    attn_bwd_block_q: int = 0   # 0 = same as attn_block_q
+    attn_bwd_block_k: int = 0   # 0 = same as attn_block_k
     use_bias: bool = True
     # scan over layers (True: compact HLO, one traced block) vs an unrolled
     # Python loop (False: 12x the HLO, but no lax.scan slice/stack traffic —
@@ -241,7 +249,8 @@ def _layernorm(x, scale, bias, eps=1e-5):
 
 
 def _attention(q, k, v, cfg: GPT2Config):
-    """q,k,v: [B, S, H, hd] → [B, S, H, hd], causal."""
+    """q,k,v: [B, H, S, hd] → [B, H, S, hd], causal (head-major layout — the
+    flash kernels' native one, so the hot path has no boundary transposes)."""
     from ray_tpu.parallel import mesh as mesh_lib
 
     mesh = mesh_lib.current_mesh()
@@ -261,7 +270,12 @@ def _attention(q, k, v, cfg: GPT2Config):
         if mesh is not None:
             # decide off the mesh's devices, not the process default backend
             interpret = mesh.devices.flat[0].platform != "tpu"
-        return flash_attention(q, k, v, causal=True, interpret=interpret)
+        return flash_attention(
+            q, k, v, causal=True, interpret=interpret, layout="bhsd",
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+            bwd_block_q=cfg.attn_bwd_block_q or None,
+            bwd_block_k=cfg.attn_bwd_block_k or None,
+        )
     if impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention_sharded
 
@@ -270,15 +284,19 @@ def _attention(q, k, v, cfg: GPT2Config):
                 "attention_impl='ring' needs a mesh with a cp axis; call the "
                 "model inside parallel.mesh.use_mesh(mesh) (train_step does)"
             )
-        return ring_attention_sharded(q, k, v, mesh, axis_name="cp", causal=True)
+        o = ring_attention_sharded(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), mesh, axis_name="cp", causal=True,
+        )
+        return jnp.swapaxes(o, 1, 2)
     # XLA path: einsum + mask; XLA fuses the softmax chain.
-    S = q.shape[1]
+    S = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))
     logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 def _block(x, layer_params, cfg: GPT2Config):
@@ -290,10 +308,18 @@ def _block(x, layer_params, cfg: GPT2Config):
     p = layer_params
     dt = cfg.dtype
     h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
-    qkv = jnp.einsum("bsd,dthk->bsthk", h, p["qkv_w"].astype(dt)) + p["qkv_b"].astype(dt)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,hd]
+    # head-major projection, one einsum per q/k/v: each matmul writes its
+    # output directly in the flash kernels' [B, H, S, hd] layout. A single
+    # fused [3,B,H,S,hd] einsum leaves XLA slicing+copying 36 MB per tensor
+    # to feed the custom-call (~3% of the step); three dots with the right
+    # output layout have no boundary copies at all.
+    w, b = p["qkv_w"].astype(dt), p["qkv_b"].astype(dt)
+    q, k, v = (
+        jnp.einsum("bsd,dhk->bhsk", h, w[:, i]) + b[i][None, :, None, :]
+        for i in range(3)
+    )
     attn = _attention(q, k, v, cfg)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, p["proj_w"].astype(dt)) + p["proj_b"].astype(dt)
+    x = x + jnp.einsum("bhsk,hkd->bsd", attn, p["proj_w"].astype(dt)) + p["proj_b"].astype(dt)
     h = _layernorm(x, p["ln2_scale"], p["ln2_bias"])
     if cfg.moe_experts > 0:
         from ray_tpu.ops.moe import moe_mlp
@@ -430,12 +456,15 @@ def loss_fn(
     # only be nonzero for ad-hoc shorter sequences, where logits are small
     # enough that the monolithic path is the right call anyway.
     if chunk <= 0 or S % chunk or S == chunk:
-        logits = jnp.einsum("bsd,vd->bsv", x, wte).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        mask = targets >= 0
-        safe = jnp.where(mask, targets, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1) + aux_term
+        from ray_tpu.ops.cross_entropy import softmax_xent
+
+        # fused CE (ops/cross_entropy.py): saves bf16 logits + [B,S] lse as
+        # the only residuals — the f32 [B,S,V] log-softmax tensor the naive
+        # formulation materializes (4.9 GB at bench shape) never exists.
+        logits = jnp.einsum("bsd,vd->bsv", x, wte)
+        nll = softmax_xent(logits, targets)
+        count = jnp.sum(targets >= 0)
+        return jnp.sum(nll) / jnp.maximum(count, 1) + aux_term
 
     xc = x.reshape(B, S // chunk, chunk, -1).swapaxes(0, 1)       # [n, B, c, D]
     tc = targets.reshape(B, S // chunk, chunk).swapaxes(0, 1)     # [n, B, c]

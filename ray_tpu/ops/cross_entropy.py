@@ -1,0 +1,69 @@
+"""Fused masked softmax cross-entropy (custom VJP).
+
+Why this exists: the naive `log_softmax` + `take_along_axis` loss keeps the
+full-vocabulary f32 log-probability tensor as an autodiff residual. At
+GPT-2-124M bench shape ([24, 1024, 50304]) that is a 4.9 GB HBM write plus
+re-reads — the device profile showed ~17 ms/step (8%) in those loop fusions
+alone. This op's VJP saves only the bf16 logits (which the LM-head matmul
+already produced) plus a [B, S] logsumexp:
+
+- forward: two streaming passes over the logits (row max, then exp-sum fused
+  with the one-hot pick) — no full-size f32 tensor is ever written;
+- backward: d_logits = (softmax - onehot) · g is a pure elementwise chain off
+  the saved logits, which XLA fuses straight into the two consuming backward
+  matmuls (dx and d_wte) instead of materializing it.
+
+Numerics are identical to the reference formulation (f32 max-subtracted
+softmax; tests assert equality vs jax.nn.log_softmax). Ignore index: any
+target < 0 contributes 0 loss and 0 gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _nll_and_lse(logits, targets):
+    lf = logits.astype(jnp.float32)
+    m = jnp.max(lf, axis=-1)
+    # one-hot pick via compare+select on the same pass as the exp-sum (a
+    # take_along_axis gather on the minor dim would defeat the fusion)
+    V = logits.shape[-1]
+    cols = lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = cols == targets[..., None]
+    shifted = lf - m[..., None]
+    sumexp = jnp.sum(jnp.exp(shifted), axis=-1)
+    picked = jnp.sum(jnp.where(onehot, shifted, 0.0), axis=-1)
+    lse = m + jnp.log(sumexp)
+    valid = targets >= 0
+    nll = jnp.where(valid, jnp.log(sumexp) - picked, 0.0)
+    return nll, lse
+
+
+@jax.custom_vjp
+def softmax_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """logits [..., V] (any float dtype), targets [...] int32 (< 0 = ignore)
+    → per-position negative log-likelihood [...] f32 (0 at ignored positions).
+    """
+    nll, _ = _nll_and_lse(logits, targets)
+    return nll
+
+
+def _xent_fwd(logits, targets):
+    nll, lse = _nll_and_lse(logits, targets)
+    return nll, (logits, lse, targets)
+
+
+def _xent_bwd(res, g):
+    logits, lse, targets = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    cols = lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = (cols == targets[..., None]).astype(jnp.float32)
+    gm = jnp.where(targets >= 0, g, 0.0)[..., None]
+    dlogits = ((p - onehot) * gm).astype(logits.dtype)
+    return dlogits, None
+
+
+softmax_xent.defvjp(_xent_fwd, _xent_bwd)

@@ -44,7 +44,10 @@ def test_causality():
 
 def test_chunked_loss_matches_monolithic():
     """The blockwise cross-entropy (loss_chunk) must equal the full-logits
-    path exactly (same math, f32 softmax) — value and gradients."""
+    path: same loss value (both f32 softmax), gradients to within one bf16
+    ulp (the fused monolithic path — ops/cross_entropy.py — recomputes the
+    backward softmax from the saved logsumexp rather than a saved log-prob
+    residual, so bf16-cast grads can differ in the last place)."""
     cfg_m = gpt2.gpt2_tiny(loss_chunk=0, seq_len=256)
     cfg_c = gpt2.gpt2_tiny(loss_chunk=64, seq_len=256)
     params = gpt2.init(cfg_m, jax.random.PRNGKey(0))
@@ -58,7 +61,7 @@ def test_chunked_loss_matches_monolithic():
     assert float(abs(l1 - l2)) < 1e-5
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(
-            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-4
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-3
         )
 
 
