@@ -163,8 +163,50 @@ class CoreWorker:
             )
         if self.mode == "driver":
             await self.gcs.call("register_driver")
+            await self._subscribe_logs()
         asyncio.ensure_future(self._flush_task_events_loop())
+        asyncio.ensure_future(self._metrics_flush_loop())
         asyncio.ensure_future(self._gcs_watchdog())
+
+    async def _subscribe_logs(self):
+        """Driver side of the log plane (reference: worker.print_logs over
+        GCS pubsub): raylet log monitors publish worker log lines; echo them
+        to this driver's stderr with a (source ip=...) prefix."""
+        if not _config.log_to_driver:
+            return
+        self.gcs.on_push("logs", self._on_log_push)
+        try:
+            await self.gcs.call("subscribe", channels=["logs"])
+        except (rpc.RpcError, rpc.ConnectionLost):
+            pass
+
+    def _on_log_push(self, batch: dict):
+        import sys
+
+        src = batch.get("source", "worker")
+        for line in batch.get("lines", []):
+            print(f"({src}) {line}", file=sys.stderr, flush=True)
+
+    async def _metrics_flush_loop(self):
+        """Flush this process's metrics registry (util/metrics.py) to the
+        GCS — covers user-defined Counters/Gauges/Histograms recorded in
+        tasks/actors on workers, and in driver code."""
+        from ray_tpu.util import metrics as metrics_api
+
+        period = max(_config.metrics_report_interval_ms, 100) / 1000
+        source = f"{self.mode}-{self.worker_id.hex()[:12]}"
+        while True:
+            await asyncio.sleep(period)
+            try:
+                samples = metrics_api.get_registry().collect()
+                if samples and self.gcs is not None and not self.gcs.closed:
+                    await self.gcs.notify(
+                        "report_metrics", source=source, samples=samples
+                    )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+            except Exception:  # noqa: BLE001
+                logger.exception("metrics flush error")
 
     async def _gcs_watchdog(self):
         """Re-dial the GCS if it restarts (fault tolerance: the store-backed
@@ -180,6 +222,7 @@ class CoreWorker:
                 )
                 if self.mode == "driver":
                     await self.gcs.call("register_driver")
+                    await self._subscribe_logs()
                 # functions registered <1s before the crash may have missed
                 # the snapshot: re-register everything we know from cache so
                 # outstanding fn_ids stay resolvable

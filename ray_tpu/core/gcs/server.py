@@ -134,6 +134,10 @@ class GcsServer:
 
         self.task_events: "deque" = deque(maxlen=10_000)
         self.metrics: Dict[str, int] = {}
+        # metrics plane: {source: (ts, [series snapshots])} flushed by every
+        # process's registry (util/metrics.py); dashboard /metrics renders
+        # the merge. In-memory only — time series storage is Prometheus's job.
+        self.metric_reports: Dict[str, Tuple[float, list]] = {}
         self._store_dirty = True  # durable-table mutation since last snapshot
         self._actor_events: Dict[bytes, asyncio.Event] = {}  # get_actor waits
 
@@ -660,6 +664,47 @@ class GcsServer:
         )
         m["num_placement_groups"] = len(self.placement_groups)
         return m
+
+    def handle_report_metrics(self, conn, source: str, samples: list):
+        """A process flushed its metrics registry (util/metrics.py)."""
+        self.metric_reports[source] = (time.time(), samples)
+        return True
+
+    def handle_collect_metrics(self, conn):
+        """Cluster-wide merged user+core metrics plus the GCS's own counters
+        (as a synthetic source), for the dashboard's /metrics endpoint."""
+        from ray_tpu.util.metrics import merge_snapshots
+
+        gcs_series = [
+            {
+                "name": "gcs_" + k, "kind": "counter", "description": "",
+                "boundaries": [], "points": {(): float(v)},
+            }
+            for k, v in self.metrics.items()
+        ]
+        gauges = {
+            "gcs_alive_nodes": sum(1 for n in self.nodes.values() if n.alive),
+            "gcs_alive_actors": sum(
+                1 for a in self.actors.values() if a.state == ALIVE
+            ),
+            "gcs_placement_groups": len(self.placement_groups),
+        }
+        gcs_series += [
+            {
+                "name": k, "kind": "gauge", "description": "",
+                "boundaries": [], "points": {(): float(v)},
+            }
+            for k, v in gauges.items()
+        ]
+        merged = merge_snapshots(
+            {**self.metric_reports, "gcs": (time.time(), gcs_series)}
+        )
+        return merged
+
+    async def handle_publish_logs(self, conn, batch: dict):
+        """A raylet's log monitor pushed a batch of worker log lines; fan
+        them out to every "logs" subscriber (drivers)."""
+        await self.publish("logs", batch)
 
     def handle_list_actors(self, conn):
         return [a.public() for a in self.actors.values()]

@@ -354,6 +354,16 @@ class LocalBackend(Backend):
         if method == "get_metrics":
             return {"num_nodes": 1, "num_alive_nodes": 1,
                     "num_actors": len(self._actors)}
+        if method == "collect_metrics":
+            # local mode: everything runs in-process, so the local registry
+            # IS the cluster-wide view
+            import time as _time
+
+            from ray_tpu.util.metrics import get_registry, merge_snapshots
+
+            return merge_snapshots(
+                {"local": (_time.time(), get_registry().collect())}
+            )
         raise ValueError(f"unknown state method {method!r}")
 
     def shutdown(self):

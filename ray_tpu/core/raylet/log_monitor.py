@@ -1,0 +1,87 @@
+"""Log monitor: tail this node's worker logs and publish lines to the driver.
+
+Parity: python/ray/_private/log_monitor.py — the reference runs a per-node
+process that tails every worker's stdout/stderr file and publishes batches
+over GCS pubsub; drivers subscribe and echo the lines, which is how a
+`print` inside a remote task on another node shows up at the driver. Here
+the tailer is an asyncio task inside the raylet (one fewer daemon), pushing
+line batches through the raylet's existing GCS connection; the GCS fans them
+out on the "logs" pubsub channel (core_worker subscribes in driver mode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_MAX_BATCH_LINES = 200
+_MAX_LINE_LEN = 8192
+
+
+class LogMonitor:
+    def __init__(self, log_dir: str, node_id: str):
+        self.log_dir = log_dir
+        self.node_id = node_id
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, bytes] = {}
+
+    def scan(self) -> List[dict]:
+        """Read newly appended lines from every worker log of this node.
+        Returns a list of batches: {source, node_id, lines}."""
+        batches: List[dict] = []
+        pattern = os.path.join(self.log_dir, f"worker-{self.node_id}-*.log")
+        for path in sorted(glob.glob(pattern)):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                if size < offset:  # truncated/rotated: start over
+                    self._offsets[path] = 0
+                    self._partial.pop(path, None)
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(1 << 20)
+                    self._offsets[path] = f.tell()
+            except OSError:
+                continue
+            data = self._partial.pop(path, b"") + chunk
+            *lines, tail = data.split(b"\n")
+            if tail:
+                self._partial[path] = tail
+            if not lines:
+                continue
+            source = os.path.basename(path)[:-len(".log")]
+            text = [
+                ln[:_MAX_LINE_LEN].decode("utf-8", "replace")
+                for ln in lines[:_MAX_BATCH_LINES]
+            ]
+            if len(lines) > _MAX_BATCH_LINES:
+                text.append(
+                    f"... ({len(lines) - _MAX_BATCH_LINES} lines dropped)"
+                )
+            batches.append(
+                {"source": source, "node_id": self.node_id, "lines": text}
+            )
+        return batches
+
+    async def run(self, publish: Callable, period_s: float = 0.25):
+        """Tail forever; `publish(batch)` is awaited per batch (raylet wires
+        this to a GCS `publish_logs` notify)."""
+        while True:
+            try:
+                for batch in self.scan():
+                    await publish(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - tailing must survive anything
+                logger.exception("log monitor scan error")
+            await asyncio.sleep(period_s)

@@ -129,6 +129,18 @@ class Raylet:
         )
         self._bg.append(asyncio.create_task(self._report_loop()))
         self._bg.append(asyncio.create_task(self._poll_loop()))
+        # observability plane: tail this node's worker logs to the driver
+        # (log_monitor.py ↔ reference log_monitor.py) and flush core metrics
+        from ray_tpu.core.raylet.log_monitor import LogMonitor
+
+        self.log_monitor = LogMonitor(
+            os.path.join("/tmp", "ray_tpu", self.session, "logs"),
+            self.node_id,
+        )
+        self._bg.append(
+            asyncio.create_task(self.log_monitor.run(self._publish_logs))
+        )
+        self._bg.append(asyncio.create_task(self._metrics_flush_loop()))
         if _config.enable_worker_prestart:
             n = min(2, int(self.total.get("CPU")) or 1)
             for _ in range(n):
@@ -206,6 +218,64 @@ class Raylet:
             except Exception:  # noqa: BLE001 - the loop must survive anything
                 logger.exception("raylet poll loop error")
             await asyncio.sleep(0.05)
+
+    async def _publish_logs(self, batch: dict):
+        if self.gcs is not None and not self.gcs.closed:
+            try:
+                await self.gcs.notify("publish_logs", batch=batch)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+
+    async def _metrics_flush_loop(self):
+        """Core raylet metrics (stats/metric_defs.cc analog): sampled gauges
+        over scheduler/worker-pool/object-store state, flushed to the GCS
+        with the rest of this process's registry."""
+        from ray_tpu.util import metrics as metrics_api
+
+        g_pending = metrics_api.Gauge(
+            "raylet_pending_leases", "lease requests queued on this raylet"
+        )
+        g_active = metrics_api.Gauge(
+            "raylet_active_leases", "leases currently holding resources"
+        )
+        g_workers = metrics_api.Gauge(
+            "raylet_workers", "worker processes by state", tag_keys=("state",)
+        )
+        g_bytes = metrics_api.Gauge(
+            "object_store_used_bytes", "bytes sealed in the local shm store"
+        )
+        g_objs = metrics_api.Gauge(
+            "object_store_num_objects", "objects in the local shm store"
+        )
+        g_spill = metrics_api.Gauge(
+            "object_store_num_spilled", "objects spilled to disk"
+        )
+        period = max(_config.metrics_report_interval_ms, 100) / 1000
+        while True:
+            try:
+                g_pending.set(len(self.pending_leases))
+                g_active.set(len(self.active_leases))
+                by_state: Dict[str, int] = {}
+                for w in self.pool.workers.values():
+                    by_state[w.state] = by_state.get(w.state, 0) + 1
+                for state, n in by_state.items():
+                    g_workers.set(n, tags={"state": state})
+                st = self.directory.stats()
+                g_bytes.set(st.get("used_bytes", 0))
+                g_objs.set(st.get("num_objects", 0))
+                g_spill.set(st.get("num_spilled", 0))
+                samples = metrics_api.get_registry().collect()
+                if samples and self.gcs is not None and not self.gcs.closed:
+                    await self.gcs.notify(
+                        "report_metrics",
+                        source=f"raylet-{self.node_id}",
+                        samples=samples,
+                    )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+            except Exception:  # noqa: BLE001 - metrics must never kill raylet
+                logger.exception("metrics flush error")
+            await asyncio.sleep(period)
 
     # ----------------------------------------------------------- scheduling
     async def handle_request_lease(

@@ -124,6 +124,15 @@ class Dashboard:
                 body = _PAGE.encode()
                 ctype = "text/html; charset=utf-8"
                 status = "200 OK"
+            elif path == "/metrics":
+                # Prometheus text exposition of the cluster-wide merge
+                # (reference: metrics_agent.py + prometheus_exporter.py)
+                from ray_tpu.util.metrics import render_prometheus
+
+                series = await self._gcs_call("collect_metrics")
+                body = render_prometheus(series).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = "200 OK"
             else:
                 data = await self._route(path)
                 if data is None:

@@ -1,0 +1,252 @@
+"""User-defined and core metrics: Counter / Gauge / Histogram.
+
+Parity: python/ray/util/metrics.py (the user API over the Cython metric
+bindings) and src/ray/stats/metric.h:103 (core metric definitions). Design
+here: every process keeps one in-memory `MetricsRegistry`; the runtime
+(core_worker, raylet, GCS) flushes snapshots to the GCS over the existing
+control connections, and the dashboard renders the cluster-wide aggregate as
+a Prometheus text endpoint (`/metrics`) — the role the reference fills with
+its per-node OpenCensus agent + prometheus_exporter.py.
+
+Usage (identical shape to the reference):
+
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    requests = Counter("app_requests", description="...", tag_keys=("route",))
+    requests.inc(1.0, tags={"route": "/predict"})
+    qsize = Gauge("app_queue_size")
+    qsize.set(3)
+    latency = Histogram("app_latency_ms", boundaries=[1, 10, 100, 1000])
+    latency.observe(12.5)
+
+Metrics are registered process-wide on construction; constructing the same
+name twice returns independent handles onto the same underlying series.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_TagTuple = Tuple[Tuple[str, str], ...]
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> _TagTuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Series:
+    """One named metric's state across all tag combinations."""
+
+    def __init__(self, name: str, kind: str, description: str,
+                 boundaries: Optional[Sequence[float]] = None):
+        self.name = name
+        self.kind = kind  # counter | gauge | histogram
+        self.description = description
+        self.boundaries = list(boundaries or [])
+        self.lock = threading.Lock()
+        # counter/gauge: tags -> float
+        # histogram: tags -> [bucket_counts..., +inf_count, sum, count]
+        self.points: Dict[_TagTuple, object] = {}
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            pts = {
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in self.points.items()
+            }
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "description": self.description,
+            "boundaries": self.boundaries,
+            "points": pts,
+        }
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+
+    def series(self, name: str, kind: str, description: str,
+               boundaries=None) -> _Series:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                s = _Series(name, kind, description, boundaries)
+                self._series[name] = s
+            elif s.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {s.kind}"
+                )
+            return s
+
+    def collect(self) -> List[dict]:
+        with self._lock:
+            series = list(self._series.values())
+        return [s.snapshot() for s in series if s.points]
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+class _Metric:
+    KIND = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None, **kw):
+        if not name or not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._series = _registry.series(name, self.KIND, description, **kw)
+
+    @property
+    def name(self) -> str:
+        return self._series.name
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        """Tags merged under every record (reference API parity)."""
+        self._default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]) -> _TagTuple:
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self._tag_keys)
+        if extra and self._tag_keys:
+            raise ValueError(
+                f"tags {sorted(extra)} not declared in tag_keys for "
+                f"metric {self.name!r}"
+            )
+        return _tags_key(merged)
+
+
+class Counter(_Metric):
+    """Monotonically increasing value (aggregated as a sum across processes)."""
+
+    KIND = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        key = self._resolve_tags(tags)
+        s = self._series
+        with s.lock:
+            s.points[key] = s.points.get(key, 0.0) + value
+
+
+class Gauge(_Metric):
+    """Last-write-wins value (exported per process, `source` label added)."""
+
+    KIND = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._resolve_tags(tags)
+        s = self._series
+        with s.lock:
+            s.points[key] = float(value)
+
+
+class Histogram(_Metric):
+    """Bucketed distribution with Prometheus-style cumulative export."""
+
+    KIND = "histogram"
+
+    def __init__(self, name, description: str = "", boundaries=None,
+                 tag_keys=None):
+        if not boundaries:
+            boundaries = [0.001, 0.01, 0.1, 1, 10, 100, 1000]
+        if list(boundaries) != sorted(boundaries):
+            raise ValueError("histogram boundaries must be sorted")
+        super().__init__(name, description, tag_keys, boundaries=boundaries)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._resolve_tags(tags)
+        s = self._series
+        with s.lock:
+            pt = s.points.get(key)
+            if pt is None:
+                pt = [0] * (len(s.boundaries) + 1) + [0.0, 0]
+                s.points[key] = pt
+            idx = len(s.boundaries)
+            for i, b in enumerate(s.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            pt[idx] += 1
+            pt[-2] += value
+            pt[-1] += 1
+
+
+# ----------------------------------------------------------------------- #
+# Aggregation + Prometheus text rendering (used by GCS/dashboard)
+# ----------------------------------------------------------------------- #
+
+def merge_snapshots(per_source: Dict[str, Tuple[float, List[dict]]],
+                    stale_after_s: float = 120.0) -> List[dict]:
+    """Merge {source: (ts, [series snapshots])} into one list. Counters and
+    histograms sum across sources; gauges keep one point per source (a
+    `source` tag is added so concurrent reporters don't clobber each other)."""
+    now = time.time()
+    merged: Dict[str, dict] = {}
+    for source, (ts, series_list) in per_source.items():
+        if now - ts > stale_after_s:
+            continue
+        for snap in series_list:
+            m = merged.setdefault(
+                snap["name"],
+                {**snap, "points": {}},
+            )
+            for tags, val in snap["points"].items():
+                if snap["kind"] == "gauge":
+                    key = tags + (("source", source),)
+                    m["points"][key] = val
+                elif snap["kind"] == "histogram":
+                    cur = m["points"].get(tags)
+                    if cur is None:
+                        m["points"][tags] = list(val)
+                    else:
+                        m["points"][tags] = [a + b for a, b in zip(cur, val)]
+                else:
+                    m["points"][tags] = m["points"].get(tags, 0.0) + val
+    return list(merged.values())
+
+
+def _fmt_tags(tags: _TagTuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in tags] + ([extra] if extra else [])
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(series_list: List[dict]) -> str:
+    """Prometheus text exposition format (text/plain; version=0.0.4)."""
+    out: List[str] = []
+    for s in sorted(series_list, key=lambda s: s["name"]):
+        name, kind = s["name"], s["kind"]
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}[kind]
+        if s.get("description"):
+            out.append(f"# HELP {name} {s['description']}")
+        out.append(f"# TYPE {name} {ptype}")
+        for tags, val in sorted(s["points"].items()):
+            if kind == "histogram":
+                cum = 0
+                for i, b in enumerate(s["boundaries"]):
+                    cum += val[i]
+                    out.append(
+                        f"{name}_bucket{_fmt_tags(tags, f'le=\"{b}\"')} {cum}"
+                    )
+                cum += val[len(s["boundaries"])]
+                out.append(
+                    f"{name}_bucket{_fmt_tags(tags, 'le=\"+Inf\"')} {cum}"
+                )
+                out.append(f"{name}_sum{_fmt_tags(tags)} {val[-2]}")
+                out.append(f"{name}_count{_fmt_tags(tags)} {val[-1]}")
+            else:
+                out.append(f"{name}{_fmt_tags(tags)} {val}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,142 @@
+"""Observability plane: metrics (Counter/Gauge/Histogram → /metrics) and
+log streaming (worker print → driver stderr).
+
+Parity targets: python/ray/util/metrics.py + _private/metrics_agent.py →
+prometheus (the metrics API and exposition), python/ray/_private/
+log_monitor.py (worker logs reach the driver).
+"""
+
+import re
+import time
+import urllib.request
+
+import pytest
+
+from ray_tpu.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    get_registry,
+    merge_snapshots,
+    render_prometheus,
+)
+
+
+# --------------------------------------------------------------- unit level
+def test_counter_gauge_histogram_collect():
+    c = Counter("t_requests", description="req", tag_keys=("route",))
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(1.0, tags={"route": "/a"})
+    c.inc(5.0, tags={"route": "/b"})
+    g = Gauge("t_qsize")
+    g.set(3)
+    g.set(7)
+    h = Histogram("t_latency", boundaries=[1, 10])
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+
+    snaps = {s["name"]: s for s in get_registry().collect()}
+    assert snaps["t_requests"]["points"][(("route", "/a"),)] == 3.0
+    assert snaps["t_requests"]["points"][(("route", "/b"),)] == 5.0
+    assert snaps["t_qsize"]["points"][()] == 7.0
+    hp = snaps["t_latency"]["points"][()]
+    assert hp[:3] == [1, 1, 1] and hp[-2] == 55.5 and hp[-1] == 3
+
+
+def test_counter_rejects_negative_and_undeclared_tags():
+    c = Counter("t_neg", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"other": "x"})
+    with pytest.raises(ValueError):
+        Gauge("t_neg")  # name already registered as counter
+
+
+def test_merge_and_render():
+    now = time.time()
+    mk = lambda kind, pts, **kw: {
+        "name": "m", "kind": kind, "description": "d",
+        "boundaries": kw.get("boundaries", []), "points": pts,
+    }
+    # counters sum across sources; gauges get a source label
+    merged = merge_snapshots({
+        "w1": (now, [mk("counter", {(): 2.0})]),
+        "w2": (now, [mk("counter", {(): 3.0})]),
+        "stale": (now - 1e6, [mk("counter", {(): 100.0})]),
+    })
+    assert merged[0]["points"][()] == 5.0
+    merged_g = merge_snapshots({
+        "w1": (now, [mk("gauge", {(): 1.0})]),
+        "w2": (now, [mk("gauge", {(): 2.0})]),
+    })
+    assert len(merged_g[0]["points"]) == 2
+
+    text = render_prometheus([
+        {"name": "app_lat", "kind": "histogram", "description": "lat",
+         "boundaries": [1, 10], "points": {(): [1, 2, 3, 55.5, 6]}},
+        {"name": "app_req", "kind": "counter", "description": "",
+         "points": {(("route", "/a"),): 3.0}, "boundaries": []},
+    ])
+    assert '# TYPE app_lat histogram' in text
+    assert 'app_lat_bucket{le="1"} 1' in text
+    assert 'app_lat_bucket{le="10"} 3' in text
+    assert 'app_lat_bucket{le="+Inf"} 6' in text
+    assert 'app_lat_sum 55.5' in text and 'app_lat_count 6' in text
+    assert 'app_req{route="/a"} 3.0' in text
+
+
+# ---------------------------------------------------------- cluster level
+def test_worker_print_reaches_driver_and_metrics_export(capfd):
+    """A print() inside a remote task must appear on the driver (the
+    log-monitor → GCS pubsub → driver path), and metrics recorded in a
+    worker must show up on the dashboard's Prometheus /metrics endpoint."""
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        @ray_tpu.remote
+        def noisy(x):
+            from ray_tpu.util.metrics import Counter
+
+            print(f"hello-from-worker-{x}")
+            Counter("t_worker_tasks", description="tasks run").inc()
+            return x
+
+        assert ray_tpu.get([noisy.remote(i) for i in range(3)]) == [0, 1, 2]
+
+        # log lines flow: raylet tail (250ms) -> GCS -> driver push
+        deadline = time.monotonic() + 15
+        seen = ""
+        while time.monotonic() < deadline:
+            seen += capfd.readouterr().err
+            if len(re.findall(r"hello-from-worker-\d", seen)) >= 3:
+                break
+            time.sleep(0.3)
+        assert len(re.findall(r"hello-from-worker-\d", seen)) >= 3, seen
+        assert "(worker-" in seen  # source prefix
+
+        # metrics flow: worker flush (2s period) -> GCS -> /metrics
+        from ray_tpu.api import _global_worker
+
+        gcs_addr = _global_worker().backend.core.gcs_address
+        dash = start_dashboard(gcs_addr, port=0)
+        deadline = time.monotonic() + 20
+        text = ""
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(dash.url + "/metrics", timeout=5) as r:
+                text = r.read().decode()
+            if "t_worker_tasks 3.0" in text:
+                break
+            time.sleep(0.5)
+        assert "# TYPE t_worker_tasks counter" in text
+        assert "t_worker_tasks 3.0" in text, text
+        # core raylet metrics ride the same plane
+        assert "raylet_workers" in text
+        assert "object_store_used_bytes" in text
+        dash.stop()
+    finally:
+        ray_tpu.shutdown()
