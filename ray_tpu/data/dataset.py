@@ -91,20 +91,19 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return Dataset(self._base_ops() + [LimitOp(n)])
 
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Block-local shuffle + shuffled block order (approximate global
-        shuffle; the reference's full push-based shuffle is a two-stage
-        repartition — per-window shuffling is its streaming default too)."""
-        rng_seed = seed if seed is not None else 0
+    def random_shuffle(self, seed: Optional[int] = None,
+                       num_partitions: Optional[int] = None) -> "Dataset":
+        """GLOBAL random shuffle via the two-stage push shuffle
+        (data/shuffle.py ↔ reference push_based_shuffle.py): rows scatter
+        uniformly over reducers, each reducer permutes. Any row can land in
+        any output block; the driver only handles refs."""
+        from ray_tpu.data.shuffle import random_shuffle_blocks
 
-        def shuffle_block(block: Block) -> Block:
-            from ray_tpu.data.block import block_take
-
-            n = block_num_rows(block)
-            rng = np.random.default_rng(rng_seed + n)
-            return block_take(block, rng.permutation(n))
-
-        return self.map_batches(shuffle_block)
+        refs = list(self.iter_block_refs())
+        out = random_shuffle_blocks(
+            refs, seed, num_partitions or max(len(refs), 1)
+        )
+        return Dataset([], materialized_refs=out)
 
 
     def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
@@ -141,24 +140,22 @@ class Dataset:
             refs.append(rs[0] if len(rs) == 1 else merge.remote(*rs))
         return Dataset([], materialized_refs=refs)
 
-    def sort(self, key: str, descending: bool = False) -> "Dataset":
-        """Global sort by a column (parity: Dataset.sort). EAGER: the sorted
-        dataset materializes on the driver (one concat + argsort — works for
-        any comparable dtype including strings); a distributed range-
-        partitioned sort is the scale-up path when blocks outgrow driver
-        RAM."""
-        import ray_tpu
+    def sort(self, key: str, descending: bool = False,
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Global sort by a column — DISTRIBUTED range-partitioned shuffle
+        sort (data/shuffle.py ↔ reference push_based_shuffle.py + sort.py):
+        sample key quantiles → range-partition map tasks → per-partition
+        sort reducers. The driver holds only refs and the O(blocks×256)
+        boundary sample, never a concatenated dataset."""
+        from ray_tpu.data.shuffle import sort_shuffle
 
-        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
-        blocks = [b for b in blocks if block_num_rows(b) > 0]
-        if not blocks:
+        refs = list(self.iter_block_refs())
+        if not refs:
             return Dataset([], materialized_refs=[])
-        whole = block_concat(blocks)
-        order = np.argsort(whole[key], kind="stable")
-        if descending:
-            order = order[::-1]
-        out = block_take(whole, order)
-        return Dataset([], materialized_refs=[ray_tpu.put(out)])
+        out = sort_shuffle(
+            refs, key, descending, num_partitions or max(len(refs), 1)
+        )
+        return Dataset([], materialized_refs=out)
 
     def groupby(self, key: str) -> "GroupedDataset":
         return GroupedDataset(self, key)
@@ -327,6 +324,39 @@ class GroupedDataset:
                 if mx is not None:
                     e[3] = mx if e[3] is None else max(e[3], mx)
         return combined
+
+    def map_groups(self, fn: Callable[[Block], Any],
+                   num_partitions: Optional[int] = None) -> Dataset:
+        """Apply fn to each key's full group block (parity:
+        GroupedData.map_groups). Backed by the distributed hash shuffle:
+        every key's rows meet in exactly one partition task — the driver
+        never materializes groups."""
+        import ray_tpu
+
+        from ray_tpu.data.shuffle import hash_partition
+
+        key = self._key
+        refs = list(self._ds.iter_block_refs())
+        if not refs:
+            return Dataset([], materialized_refs=[])
+        parts = hash_partition(refs, key, num_partitions or max(len(refs), 1))
+
+        def apply_groups(block: Block) -> Block:
+            ks = block[key]
+            keys = [k.item() if hasattr(k, "item") else k for k in ks]
+            order: Dict[Any, list] = {}
+            for i, k in enumerate(keys):
+                order.setdefault(k, []).append(i)
+            outs = []
+            for k, idxs in order.items():
+                sub = block_take(block, np.asarray(idxs))
+                res = fn(sub)
+                outs.append(res if isinstance(res, dict) else
+                            block_from_rows([res]))
+            return block_concat(outs) if outs else block
+
+        run = ray_tpu.remote(num_cpus=0.25)(apply_groups)
+        return Dataset([], materialized_refs=[run.remote(p) for p in parts])
 
     def count(self) -> Dict[Any, int]:
         return {k: e[0] for k, e in self._partials(None).items()}
