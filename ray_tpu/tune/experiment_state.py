@@ -1,0 +1,110 @@
+"""Experiment-level state persistence: crash-safe Tune runs + Tuner.restore.
+
+Parity: tune/execution/experiment_state.py (`_ExperimentCheckpointManager`)
++ tuner.py `Tuner.restore`. The controller snapshots the full experiment —
+every trial's config, status, result history, error, and latest checkpoint
+file — into `<storage_path>/<name>/experiment_state.json` after every event,
+with trial checkpoints stored alongside as tarballs. `Tuner.restore(path)`
+rebuilds the trial set: finished trials stay finished (their histories load
+into the ResultGrid), unfinished trials restart PENDING from their latest
+checkpoint. The write is atomic (tmp + rename), so a kill at any moment
+leaves a loadable state file.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.tune.trial import ERROR, PENDING, TERMINATED, Trial
+
+STATE_FILE = "experiment_state.json"
+TUNER_FILE = "tuner.pkl"
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp_state_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def save_tuner_meta(exp_dir: str, *, trainable_cls, tune_config, param_space,
+                    trial_resources, stop) -> None:
+    blob = cloudpickle.dumps({
+        "trainable_cls": trainable_cls,
+        "tune_config": tune_config,
+        "param_space": param_space,
+        "trial_resources": trial_resources,
+        "stop": stop,
+    })
+    _atomic_write(os.path.join(exp_dir, TUNER_FILE), blob)
+
+
+def load_tuner_meta(exp_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(exp_dir, TUNER_FILE), "rb") as f:
+        return cloudpickle.loads(f.read())
+
+
+def trial_ckpt_path(exp_dir: str, trial_id: str) -> str:
+    return os.path.join(exp_dir, f"trial_{trial_id}.ckpt")
+
+
+def save_state(exp_dir: str, trials: List[Trial]) -> None:
+    state = {
+        "trials": [
+            {
+                "trial_id": t.trial_id,
+                "config_b64": base64.b64encode(
+                    cloudpickle.dumps(t.config)
+                ).decode(),
+                "status": t.status,
+                "results": t.results,
+                "error": t.error,
+                "ckpt_file": t.ckpt_file,
+            }
+            for t in trials
+        ],
+    }
+    _atomic_write(
+        os.path.join(exp_dir, STATE_FILE),
+        json.dumps(state, default=str).encode(),
+    )
+
+
+def load_trials(exp_dir: str) -> List[Trial]:
+    """Rebuild trials for a resumed run. TERMINATED/ERROR trials keep their
+    terminal status; anything mid-flight becomes PENDING and will restore
+    from its recorded checkpoint when (re)started."""
+    with open(os.path.join(exp_dir, STATE_FILE)) as f:
+        state = json.load(f)
+    trials: List[Trial] = []
+    for rec in state["trials"]:
+        t = Trial(
+            config=cloudpickle.loads(base64.b64decode(rec["config_b64"])),
+            trial_id=rec["trial_id"],
+        )
+        t.results = rec.get("results") or []
+        t.error = rec.get("error")
+        ck = rec.get("ckpt_file")
+        if ck and os.path.exists(ck):
+            t.ckpt_file = ck
+        status = rec.get("status")
+        t.status = status if status in (TERMINATED, ERROR) else PENDING
+        trials.append(t)
+    return trials
+
+
+def has_state(exp_dir: Optional[str]) -> bool:
+    return bool(exp_dir) and os.path.exists(os.path.join(exp_dir, STATE_FILE))

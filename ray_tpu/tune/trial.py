@@ -25,6 +25,7 @@ class Trial:
     status: str = PENDING
     results: List[Dict[str, Any]] = field(default_factory=list)
     checkpoint_dir: Optional[str] = None
+    ckpt_file: Optional[str] = None   # latest persisted checkpoint tarball
     error: Optional[str] = None
     actor: Any = None           # ActorHandle while RUNNING/PAUSED
     inflight: Any = None        # ObjectRef of the pending train() call

@@ -89,8 +89,15 @@ class TuneController:
         stop: Optional[Dict[str, Any]] = None,
         trial_resources: Optional[Dict[str, float]] = None,
         trial_wait_timeout_s: Optional[float] = None,
+        experiment_dir: Optional[str] = None,
+        checkpoint_frequency: int = 1,
     ):
         assert mode in ("min", "max")
+        # experiment-level persistence (experiment_state.py): when set, the
+        # full trial table snapshots after every event and each trial's
+        # checkpoint lands beside it — a killed run resumes via Tuner.restore
+        self.experiment_dir = experiment_dir
+        self.checkpoint_frequency = max(checkpoint_frequency, 1)
         self.trainable_cls = trainable_cls
         self.trials = trials
         self.metric = metric
@@ -137,7 +144,13 @@ class TuneController:
                 self._start_trial(t)
 
     def _start_trial(self, t: Trial) -> None:
+        import ray_tpu
+
         t.actor = self._remote_cls.remote(self.trainable_cls, t.config)
+        if t.ckpt_file and os.path.exists(t.ckpt_file):
+            # resumed trial: rebuild the trainable from its last checkpoint
+            with open(t.ckpt_file, "rb") as f:
+                ray_tpu.get(t.actor.restore_from_object.remote(f.read()))
         t.status = trial_mod.RUNNING
         t.inflight = t.actor.train.remote()
 
@@ -167,6 +180,7 @@ class TuneController:
             self._terminate(t, status=trial_mod.ERROR)
             return
         t.results.append(result)
+        self._maybe_checkpoint(t)
 
         if self._hit_stop_criteria(result) or result.get("done"):
             self._terminate(t)
@@ -178,6 +192,37 @@ class TuneController:
             self._terminate(t)
         else:
             t.inflight = t.actor.train.remote()
+        self._save_state()
+
+    def _maybe_checkpoint(self, t: Trial) -> None:
+        """Persist the trial's trainable state every checkpoint_frequency
+        results (the resume point for Tuner.restore)."""
+        if not self.experiment_dir or t.actor is None:
+            return
+        if len(t.results) % self.checkpoint_frequency:
+            return
+        import ray_tpu
+
+        from ray_tpu.tune import experiment_state as exp_state
+
+        try:
+            data = ray_tpu.get(t.actor.save_to_object.remote(), timeout=120)
+        except Exception:  # noqa: BLE001 - checkpointing must not kill trials
+            logger.exception("checkpoint of trial %s failed", t.trial_id)
+            return
+        path = exp_state.trial_ckpt_path(self.experiment_dir, t.trial_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        t.ckpt_file = path
+
+    def _save_state(self) -> None:
+        if not self.experiment_dir:
+            return
+        from ray_tpu.tune import experiment_state as exp_state
+
+        exp_state.save_state(self.experiment_dir, self.trials)
 
     def _hit_stop_criteria(self, result: Dict[str, Any]) -> bool:
         for key, bound in self.stop_criteria.items():
@@ -220,6 +265,7 @@ class TuneController:
         if t.status not in (trial_mod.ERROR,):
             t.status = status
         t.inflight = None
+        self._save_state()
 
     def _kill_actor(self, t: Trial) -> None:
         import ray_tpu

