@@ -15,11 +15,13 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import AutoscalingConfig, Deployment
 
 __all__ = [
     "AutoscalingConfig",
     "Deployment",
+    "batch",
     "delete",
     "deployment",
     "get_handle",

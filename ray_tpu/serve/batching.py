@@ -1,0 +1,156 @@
+"""Dynamic request batching: @serve.batch.
+
+Parity: python/ray/serve/batching.py — the decorator that turns per-request
+calls into batched invocations of the user function, the core TPU serving
+primitive (one batched forward pass amortizes the MXU across requests).
+
+Shape differences from the reference, by design: our replicas execute
+concurrent requests on a thread pool (worker_main max_concurrency), not an
+asyncio loop — so the batcher is thread-based. Each caller blocks on a
+Future; a dedicated flusher thread assembles batches of up to
+`max_batch_size` items, waiting at most `batch_wait_timeout_s` after the
+first item arrives, and invokes the wrapped function ONCE with the list of
+items. The function must return a list of results of the same length (one
+per item, positionally), or raise — the exception then propagates to every
+caller in the batch.
+
+    @serve.deployment(max_ongoing_requests=32)
+    class Model:
+        @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.01)
+        def __call__(self, inputs):        # inputs: list of requests
+            return model_forward(np.stack(inputs)).tolist()
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    """Per-(instance, method) batching state + flusher thread."""
+
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.cond = threading.Condition()
+        self.items: List[tuple] = []          # (arg, Future)
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="serve-batcher"
+        )
+        self._thread.start()
+
+    def submit(self, arg: Any) -> Any:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self.cond:
+            self.items.append((arg, fut))
+            self.cond.notify()
+        return fut.result()
+
+    def _take_batch(self) -> List[tuple]:
+        """Block until a batch is due: full, or timeout after first item."""
+        with self.cond:
+            while not self.items:
+                self.cond.wait()
+            deadline = time.monotonic() + self.timeout
+            while len(self.items) < self.max:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self.cond.wait(timeout=remaining)
+            batch, self.items = self.items[:self.max], self.items[self.max:]
+            return batch
+
+    def _flush_loop(self):
+        while True:
+            batch = self._take_batch()
+            args = [a for a, _ in batch]
+            futs = [f for _, f in batch]
+            try:
+                results = self.fn(args)
+                if results is None or len(results) != len(args):
+                    raise TypeError(
+                        f"@serve.batch function must return a list with one "
+                        f"result per input ({len(args)} inputs, got "
+                        f"{results!r})"
+                    )
+                for f, r in zip(futs, results):
+                    f.set_result(r)
+            except BaseException as e:  # noqa: BLE001 - fan the error out
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+class _BatchedCallable:
+    """Descriptor wrapping a method (or function): per-instance queues."""
+
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._free_queue: Optional[_BatchQueue] = None  # plain-function case
+        self._lock = threading.Lock()
+        functools.update_wrapper(self, fn)
+
+    def __reduce__(self):
+        # deployments ship their class through cloudpickle; runtime state
+        # (lock, queues, flusher threads) must not ride along — rebuild
+        # fresh on the replica from the decoration parameters
+        return (_BatchedCallable, (self._fn, self._max, self._wait))
+
+    # plain function usage: batched_fn(item)
+    def __call__(self, *args):
+        if len(args) != 1:
+            raise TypeError(
+                "@serve.batch callables take exactly one request argument"
+            )
+        with self._lock:
+            if self._free_queue is None:
+                self._free_queue = _BatchQueue(self._fn, self._max, self._wait)
+        return self._free_queue.submit(args[0])
+
+    # method usage: instance attribute access binds a per-instance queue
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        q = self._queue_for(obj)
+
+        def bound(item):
+            return q.submit(item)
+
+        functools.update_wrapper(bound, self._fn)
+        bound._batch_queue = q  # introspection/testing hook
+        return bound
+
+    def _queue_for(self, obj) -> _BatchQueue:
+        with self._lock:
+            queues = obj.__dict__.setdefault("__serve_batch_queues__", {})
+            q = queues.get(id(self))
+            if q is None:
+                q = _BatchQueue(
+                    functools.partial(self._fn, obj), self._max, self._wait
+                )
+                queues[id(self)] = q
+            return q
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator (with or without arguments), reference-API compatible."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    if batch_wait_timeout_s < 0:
+        raise ValueError("batch_wait_timeout_s must be >= 0")
+
+    def deco(fn):
+        return _BatchedCallable(fn, max_batch_size, batch_wait_timeout_s)
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco
