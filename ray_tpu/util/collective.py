@@ -8,10 +8,12 @@ Parity: python/ray/util/collective/collective.py — init_collective_group
 TPU-native stance: device-plane collectives belong to XLA (psum/all_gather
 inside pjit over a mesh — a library concern, not a runtime one). What Ray's
 API adds is HOST-plane group communication between actors (weight broadcast,
-metric reduction, rendezvous barriers), so the backend here is the object
-store + a named Rendezvous actor per group — no side channel, works across
-any processes that share a cluster. Arrays stay numpy end-to-end; a jax
-leaf is device_get'd on entry.
+metric reduction, rendezvous barriers). Transport: direct worker-to-worker
+TCP rings (_collective_transport.py) — the named group actor exchanges only
+{rank: address}; tensor bytes never pass through it. allreduce is the
+bandwidth-optimal ring (reduce-scatter + all-gather over world-size chunks),
+so per-rank traffic is 2·(W-1)/W · bytes regardless of W. Arrays stay numpy
+end-to-end; a jax leaf is device_get'd on entry.
 """
 
 from __future__ import annotations
@@ -21,49 +23,34 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-REDUCE_OPS = {
-    "sum": lambda arrs: np.sum(arrs, axis=0),
-    "prod": lambda arrs: np.prod(arrs, axis=0),
-    "max": lambda arrs: np.max(arrs, axis=0),
-    "min": lambda arrs: np.min(arrs, axis=0),
-    "mean": lambda arrs: np.mean(arrs, axis=0),
+from ray_tpu.util._collective_transport import PeerEndpoint
+
+# pairwise reduce kernels for the ring steps ("mean" sums then divides by W)
+PAIR_OPS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "max": np.maximum,
+    "min": np.minimum,
+    "mean": np.add,
 }
+REDUCE_OPS = PAIR_OPS  # back-compat name
 
 
 class _GroupState:
-    """Named actor holding one group's rendezvous state. Every collective is
-    round-based: rank i contributes (round, rank, ref/value); the state
-    releases results once all world_size contributions for a round arrive."""
+    """Named actor holding one group's membership: rank → transport address.
+    Only addresses cross this actor — never tensor bytes."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
-        self.rounds: Dict[str, Dict[int, Any]] = {}
-        self.results: Dict[str, Any] = {}
-        self.p2p: Dict[tuple, Any] = {}
+        self.addresses: Dict[int, str] = {}
 
-    def contribute(self, op_key: str, rank: int, value: Any) -> None:
-        self.rounds.setdefault(op_key, {})[rank] = value
+    def register(self, rank: int, address: str) -> None:
+        self.addresses[rank] = address
 
-    def collect(self, op_key: str, rank: int) -> Optional[Dict[int, Any]]:
-        """Returns the full round once every rank contributed; the round is
-        freed only after every rank has read it (no early-cleanup race)."""
-        contributions = self.rounds.get(op_key)
-        if contributions is None or len(contributions) < self.world_size:
+    def get_addresses(self) -> Optional[Dict[int, str]]:
+        if len(self.addresses) < self.world_size:
             return None
-        out = dict(contributions)
-        readers = self.results.setdefault(("readers", op_key), set())
-        readers.add(rank)
-        if len(readers) >= self.world_size:
-            self.rounds.pop(op_key, None)
-            self.results.pop(("readers", op_key), None)
-        return out
-
-    # point-to-point mailbox
-    def post(self, key: tuple, value: Any) -> None:
-        self.p2p[key] = value
-
-    def take(self, key: tuple) -> Any:
-        return self.p2p.pop(key, None)
+        return dict(self.addresses)
 
 
 _groups: Dict[str, "CollectiveGroup"] = {}
@@ -76,7 +63,9 @@ class CollectiveGroup:
         self.name = group_name
         self.world_size = world_size
         self.rank = rank
-        self._counters: Dict[str, int] = {}
+        self._round = 0
+        self._p2p_seq: Dict[tuple, int] = {}
+        self._endpoint = PeerEndpoint(advertise=_advertise_host())
         state_name = f"__collective_{group_name}"
         try:
             self._state = ray_tpu.get_actor(state_name)
@@ -88,88 +77,167 @@ class CollectiveGroup:
                 ).remote(world_size)
             except Exception:  # noqa: BLE001 - lost the naming race
                 self._state = ray_tpu.get_actor(state_name)
+        ray_tpu.get(
+            self._state.register.remote(rank, self._endpoint.address)
+        )
+        self._addresses: Optional[Dict[int, str]] = None
 
     # ------------------------------------------------------------ internals
-    def _op_key(self, op: str) -> str:
-        n = self._counters.get(op, 0)
-        self._counters[op] = n + 1
-        return f"{op}:{n}"
-
-    def _gather_round(self, op: str, value: Any, timeout: float) -> Dict[int, Any]:
+    def _peers(self, timeout: float = 60.0) -> Dict[int, str]:
         import ray_tpu
 
-        key = self._op_key(op)
-        # top-level args pass by value (the runtime resolves refs before the
-        # handler runs), so contributions ride the arg path directly
-        payload = _to_numpy(value) if value is not None else None
-        ray_tpu.get(self._state.contribute.remote(key, self.rank, payload))
+        if self._addresses is not None:
+            return self._addresses
         deadline = time.monotonic() + timeout
         while True:
-            contributions = ray_tpu.get(
-                self._state.collect.remote(key, self.rank)
-            )
-            if contributions is not None:
-                break
+            addrs = ray_tpu.get(self._state.get_addresses.remote())
+            if addrs is not None:
+                self._addresses = addrs
+                return addrs
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"collective {op} timed out in group {self.name!r} "
-                    f"({self.world_size} ranks expected)"
+                    f"group {self.name!r}: only partial membership after "
+                    f"{timeout}s ({self.world_size} ranks expected)"
                 )
-            time.sleep(0.005)
-        return contributions
+            time.sleep(0.01)
+
+    def _next_round(self) -> int:
+        self._round += 1
+        return self._round
+
+    def _ring_send(self, to_rank: int, tag, arr: np.ndarray) -> None:
+        self._endpoint.send(self._peers()[to_rank], self.rank, tag, arr)
 
     # ------------------------------------------------------------ collectives
+    def _ring_reduce_scatter(self, chunks: List[np.ndarray], op: str,
+                             rnd: int, timeout: float) -> int:
+        """In-place ring reduce-scatter over `chunks`; returns the index of
+        the fully reduced chunk this rank owns (== self.rank)."""
+        W, r = self.world_size, self.rank
+        right, left = (r + 1) % W, (r - 1) % W
+        fn = PAIR_OPS[op]
+        for s in range(W - 1):
+            ci_send = (r - s - 1) % W
+            ci_recv = (r - s - 2) % W
+            self._ring_send(right, (self.name, rnd, "rs", s), chunks[ci_send])
+            incoming = self._endpoint.recv(
+                left, (self.name, rnd, "rs", s), timeout
+            )
+            chunks[ci_recv] = fn(chunks[ci_recv], incoming)
+        return r
+
     def allreduce(self, tensor: Any, op: str = "sum", timeout: float = 60.0):
-        vals = self._gather_round("allreduce", tensor, timeout)
-        arrs = [vals[r] for r in sorted(vals)]
-        return REDUCE_OPS[op](arrs)
+        x = _to_numpy(tensor)
+        W, r = self.world_size, self.rank
+        if W == 1:
+            return x.copy()
+        rnd = self._next_round()
+        flat = np.ascontiguousarray(x).reshape(-1)
+        chunks = [c.copy() for c in np.array_split(flat, W)]
+        own = self._ring_reduce_scatter(chunks, op, rnd, timeout)
+        # all-gather phase: rotate the reduced chunks W-1 times
+        right, left = (r + 1) % W, (r - 1) % W
+        for s in range(W - 1):
+            ci_send = (own - s) % W
+            ci_recv = (own - s - 1) % W
+            self._ring_send(right, (self.name, rnd, "ag", s), chunks[ci_send])
+            chunks[ci_recv] = self._endpoint.recv(
+                left, (self.name, rnd, "ag", s), timeout
+            )
+        out = np.concatenate(chunks).reshape(x.shape)
+        if op == "mean":
+            out = out / W
+        return out
 
     def allgather(self, tensor: Any, timeout: float = 60.0) -> List[np.ndarray]:
-        vals = self._gather_round("allgather", tensor, timeout)
-        return [vals[r] for r in sorted(vals)]
+        x = _to_numpy(tensor)
+        W, r = self.world_size, self.rank
+        if W == 1:
+            return [x.copy()]
+        rnd = self._next_round()
+        right, left = (r + 1) % W, (r - 1) % W
+        slots: List[Optional[np.ndarray]] = [None] * W
+        slots[r] = x
+        for s in range(W - 1):
+            send_i = (r - s) % W
+            recv_i = (r - s - 1) % W
+            self._ring_send(right, (self.name, rnd, "ag", s), slots[send_i])
+            slots[recv_i] = self._endpoint.recv(
+                left, (self.name, rnd, "ag", s), timeout
+            )
+        return [s for s in slots]  # type: ignore[misc]
 
     def reducescatter(self, tensor: Any, op: str = "sum", timeout: float = 60.0):
         """Reduce across ranks, then return this rank's 1/world_size shard
-        (leading axis split)."""
-        reduced = self.allreduce(tensor, op, timeout)
-        shards = np.array_split(reduced, self.world_size, axis=0)
-        return shards[self.rank]
+        (leading axis split) — only the reduce-scatter half of the ring."""
+        x = _to_numpy(tensor)
+        W = self.world_size
+        if W == 1:
+            return x.copy()
+        rnd = self._next_round()
+        chunks = [c.copy() for c in np.array_split(x, W, axis=0)]
+        own = self._ring_reduce_scatter(chunks, op, rnd, timeout)
+        out = chunks[own]
+        if op == "mean":
+            out = out / W
+        return out
 
     def broadcast(self, tensor: Any, src_rank: int = 0, timeout: float = 60.0):
-        vals = self._gather_round(
-            "broadcast", tensor if self.rank == src_rank else None, timeout
-        )
-        return vals[src_rank]
+        """Pipeline ring from src: each rank forwards to its right neighbor
+        (W-1 hops; no rank handles more than one copy)."""
+        W, r = self.world_size, self.rank
+        if W == 1:
+            return _to_numpy(tensor).copy()
+        rnd = self._next_round()
+        right, left = (r + 1) % W, (r - 1) % W
+        tag = (self.name, rnd, "bc")
+        if r == src_rank:
+            out = _to_numpy(tensor)
+        else:
+            out = self._endpoint.recv(left, tag, timeout)
+        # forward unless our right neighbor is the source (ring complete)
+        if right != src_rank:
+            self._ring_send(right, tag, out)
+        return out
 
     def barrier(self, timeout: float = 60.0) -> None:
-        self._gather_round("barrier", np.zeros(()), timeout)
+        """W-1 neighbor-sync rounds: receiving round s from the left implies
+        the left neighbor finished round s-1, so after W-1 rounds every rank
+        has transitively heard from every other — nobody exits before the
+        last rank has entered."""
+        token = np.zeros((), np.uint8)
+        W, r = self.world_size, self.rank
+        if W == 1:
+            return
+        rnd = self._next_round()
+        right, left = (r + 1) % W, (r - 1) % W
+        for s in range(W - 1):
+            self._ring_send(right, (self.name, rnd, "bar", s), token)
+            self._endpoint.recv(left, (self.name, rnd, "bar", s), timeout)
 
     def send(self, tensor: Any, dst_rank: int, tag: int = 0) -> None:
-        import ray_tpu
-
-        n = self._counters.get(f"p2p:{self.rank}:{dst_rank}:{tag}", 0)
-        self._counters[f"p2p:{self.rank}:{dst_rank}:{tag}"] = n + 1
-        ray_tpu.get(
-            self._state.post.remote(
-                (self.rank, dst_rank, tag, n), _to_numpy(tensor)
-            )
+        n = self._p2p_seq.get((self.rank, dst_rank, tag), 0)
+        self._p2p_seq[(self.rank, dst_rank, tag)] = n + 1
+        self._endpoint.send(
+            self._peers()[dst_rank], self.rank,
+            ("p2p", tag, n), _to_numpy(tensor),
         )
 
     def recv(self, src_rank: int, tag: int = 0, timeout: float = 60.0):
-        import ray_tpu
+        n = self._p2p_seq.get((src_rank, self.rank, tag), 0)
+        self._p2p_seq[(src_rank, self.rank, tag)] = n + 1
+        return self._endpoint.recv(src_rank, ("p2p", tag, n), timeout)
 
-        n = self._counters.get(f"p2p:{src_rank}:{self.rank}:{tag}", 0)
-        self._counters[f"p2p:{src_rank}:{self.rank}:{tag}"] = n + 1
-        deadline = time.monotonic() + timeout
-        while True:
-            value = ray_tpu.get(
-                self._state.take.remote((src_rank, self.rank, tag, n))
-            )
-            if value is not None:
-                return value
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"recv from rank {src_rank} timed out")
-            time.sleep(0.005)
+
+def _advertise_host() -> str:
+    """The host other workers should dial: this worker's RPC-plane host."""
+    try:
+        from ray_tpu.api import _global_worker
+
+        addr = _global_worker().backend.core.address
+        return addr.rsplit(":", 1)[0]
+    except Exception:  # noqa: BLE001 - local mode / early init
+        return "127.0.0.1"
 
 
 def _to_numpy(x: Any) -> np.ndarray:
@@ -201,6 +269,7 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
     group = _groups.pop(group_name, None)
     if group is not None:
+        group._endpoint.close()
         try:
             ray_tpu.kill(group._state)
         except Exception:  # noqa: BLE001
