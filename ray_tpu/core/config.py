@@ -38,6 +38,9 @@ class Config:
     scheduler_top_k_fraction: float = 0.2
     max_pending_lease_requests_per_scheduling_key: int = 10
     worker_lease_timeout_ms: int = 10_000
+    # owner-side lease caching (SchedulingKey reuse): an idle cached lease
+    # returns to its raylet after this long without a task
+    worker_lease_idle_ttl_ms: int = 500
 
     # --- object store -------------------------------------------------------
     object_store_memory_mb: int = 2048

@@ -18,6 +18,7 @@ import concurrent.futures
 import logging
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -82,6 +83,54 @@ class _MemoryStore:
         self._events.pop(oid, None)
 
 
+@dataclass
+class _LeaseEntry:
+    """One cached worker lease (scheduling-key lease reuse)."""
+
+    raylet: Any
+    raylet_addr: str
+    lease_id: str
+    worker_addr: str
+    conn: Any
+    last_used: float = 0.0
+
+
+class _LeasePool:
+    """Per-scheduling-key lease state: idle entries + outstanding count."""
+
+    def __init__(self):
+        self.idle: List[_LeaseEntry] = []
+        self.total = 0
+        self.error: Optional[BaseException] = None  # latest failed fetch
+        from collections import deque
+
+        self._waiters: "deque" = deque()
+
+    def wake(self):
+        """Wake exactly ONE waiter (a released entry serves one task; waking
+        everyone is a thundering herd — profiled at ~10 spurious coroutine
+        resumptions per task at 50 in flight)."""
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+
+    def wake_all(self):
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+
+    async def wait(self, timeout: float):
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+
+
 class CoreWorker:
     """Driver/worker shared runtime. Thread model: user threads call the
     public methods; all networking happens on the private io-loop thread."""
@@ -106,6 +155,7 @@ class CoreWorker:
         # ownership tables (reference_count.h:61 ownership model)
         self.locations: Dict[ObjectID, dict] = {}     # owned shm objects
         self.submitted_specs: Dict[TaskID, ts.TaskSpec] = {}  # lineage
+        self._lease_pools: Dict[tuple, "_LeasePool"] = {}  # sched-key cache
         # oid → {"pending": tasks holding it as an arg, "borrowers": addrs}
         self._owned: Dict[bytes, dict] = {}
         self._task_arg_pins: Dict[TaskID, List[bytes]] = {}
@@ -167,6 +217,7 @@ class CoreWorker:
         asyncio.ensure_future(self._flush_task_events_loop())
         asyncio.ensure_future(self._metrics_flush_loop())
         asyncio.ensure_future(self._gcs_watchdog())
+        asyncio.ensure_future(self._lease_reaper_loop())
 
     async def _subscribe_logs(self):
         """Driver side of the log plane (reference: worker.print_logs over
@@ -500,7 +551,33 @@ class CoreWorker:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 break
-            await asyncio.sleep(0.01)
+            # event-driven for locally-owned refs: their readiness always
+            # lands in the memory store (value, shm marker, or error), so
+            # wake on the first event. Borrowed refs (owned elsewhere) have
+            # no local event source — they keep the coarse poll as a
+            # fallback bound on the wait.
+            owned = [
+                r for r in pending
+                if r.owner_addr in (None, self.address)
+            ]
+            if owned:
+                waiters = [
+                    asyncio.ensure_future(
+                        self.memory_store._event(r.id).wait()
+                    )
+                    for r in owned
+                ]
+                step = 0.01 if len(owned) < len(pending) else 5.0
+                if deadline is not None:
+                    step = min(step, max(0.0, deadline - time.monotonic()))
+                done, pend = await asyncio.wait(
+                    waiters, timeout=step,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                for w in pend:
+                    w.cancel()
+            else:
+                await asyncio.sleep(0.01)
         return ready, [r for r in refs if r not in ready]
 
     async def _is_ready(self, ref: ObjectRef) -> bool:
@@ -675,7 +752,101 @@ class CoreWorker:
         )
         return self.raylet
 
+    # ------------------------------------------------- lease cache (tasks)
+    # Parity: CoreWorkerDirectTaskSubmitter's SchedulingKey lease reuse
+    # (direct_task_transport.h:40-72) — a leased worker keeps executing
+    # tasks of the same scheduling key instead of a request_lease /
+    # return_lease round trip per task. Idle leases return after a TTL so
+    # cached capacity doesn't starve other keys/drivers.
+
+    def _sched_key(self, spec: ts.TaskSpec):
+        return (
+            tuple(sorted(spec.resources.items())),
+            spec.placement_group_id,
+            spec.placement_group_bundle_index,
+            repr(spec.runtime_env),
+            repr(spec.scheduling_strategy),
+        )
+
+    def _lease_pool(self, key) -> "_LeasePool":
+        pool = self._lease_pools.get(key)
+        if pool is None:
+            pool = self._lease_pools[key] = _LeasePool()
+        return pool
+
     async def _submit_once(self, spec: ts.TaskSpec) -> dict:
+        key = self._sched_key(spec)
+        pool = self._lease_pool(key)
+        entry = await self._acquire_lease(pool, spec)
+        try:
+            blob = cloudpickle.dumps(spec)
+            result = await entry.conn.call(
+                "push_task", spec_blob=blob, timeout=None
+            )
+        except rpc.ConnectionLost as e:
+            await self._drop_lease(pool, entry)
+            raise exc.WorkerCrashedError(str(e)) from e
+        except BaseException:
+            await self._drop_lease(pool, entry)
+            raise
+        entry.last_used = time.monotonic()
+        pool.idle.append(entry)
+        pool.wake()
+        return result
+
+    async def _acquire_lease(self, pool: "_LeasePool", spec) -> "_LeaseEntry":
+        """Take an idle cached lease, spawning background lease fetchers as
+        needed. Submitters never await a raylet grant directly — a queued
+        grant (resources busy) must not strand ITS task behind faster peers
+        flowing through already-cached leases; fetched entries join the
+        shared pool and any waiter takes them."""
+        while True:
+            while pool.idle:
+                entry = pool.idle.pop()
+                if entry.conn is not None and not entry.conn.closed:
+                    return entry
+                await self._drop_lease(pool, entry)
+            if pool.error is not None:
+                err, pool.error = pool.error, None
+                raise err
+            self._maybe_spawn_fetch(pool, spec)
+            await pool.wait(timeout=0.5)
+
+    def _maybe_spawn_fetch(self, pool: "_LeasePool", spec) -> None:
+        if pool.total >= _config.max_pending_lease_requests_per_scheduling_key:
+            return
+        pool.total += 1
+
+        async def fetch():
+            try:
+                entry = await self._request_new_lease(spec)
+            except BaseException as e:  # noqa: BLE001 - surface to waiters
+                pool.total -= 1
+                pool.error = e
+                pool.wake_all()  # every waiter re-checks (error/refetch)
+                return
+            if not pool._waiters and pool.idle:
+                # demand already drained (burst over): a queued grant that
+                # lands now would only churn through the idle-TTL reaper —
+                # hand it straight back
+                await self._drop_lease(pool, entry)
+                return
+            pool.idle.append(entry)
+            pool.wake()
+
+        asyncio.ensure_future(fetch())
+
+    async def _drop_lease(self, pool, entry: "_LeaseEntry"):
+        pool.total -= 1
+        pool.wake()
+        try:
+            await entry.raylet.call(
+                "return_lease", lease_id=entry.lease_id, timeout=10
+            )
+        except (rpc.RpcError, rpc.ConnectionLost):
+            pass
+
+    async def _request_new_lease(self, spec: ts.TaskSpec) -> "_LeaseEntry":
         raylet = await self._ensure_raylet()
         raylet_addr = self.raylet_address
         if spec.placement_group_id is not None:
@@ -704,8 +875,26 @@ class CoreWorker:
                     f"raylet {raylet_addr} lost during lease: {e}"
                 ) from e
             if "granted" in reply:
-                return await self._push_to_worker(
-                    raylet, raylet_addr, reply, spec
+                worker_addr = reply["granted"]
+                conn = await self._conn_to(worker_addr, kind="worker")
+                if conn is None:
+                    try:
+                        await raylet.call(
+                            "return_lease", lease_id=reply["lease_id"],
+                            timeout=10,
+                        )
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
+                    raise exc.WorkerCrashedError(
+                        f"cannot reach worker {worker_addr}"
+                    )
+                return _LeaseEntry(
+                    raylet=raylet,
+                    raylet_addr=raylet_addr,
+                    lease_id=reply["lease_id"],
+                    worker_addr=worker_addr,
+                    conn=conn,
+                    last_used=time.monotonic(),
                 )
             if "spillback" in reply:
                 raylet_addr = reply["spillback"]
@@ -719,28 +908,18 @@ class CoreWorker:
             )
         raise exc.RayTpuError("spillback loop exceeded")
 
-    async def _push_to_worker(self, raylet, raylet_addr, lease, spec) -> dict:
-        worker_addr = lease["granted"]
-        lease_id = lease["lease_id"]
-        try:
-            conn = await self._conn_to(worker_addr, kind="worker")
-            if conn is None:
-                raise exc.WorkerCrashedError(f"cannot reach worker {worker_addr}")
-            blob = cloudpickle.dumps(spec)
-            logger.debug(
-                "pushing %s %s -> %s", spec.name, spec.task_id.hex()[:8], worker_addr
-            )
-            try:
-                result = await conn.call("push_task", spec_blob=blob, timeout=None)
-                logger.debug("pushed %s %s done", spec.name, spec.task_id.hex()[:8])
-                return result
-            except rpc.ConnectionLost as e:
-                raise exc.WorkerCrashedError(str(e)) from e
-        finally:
-            try:
-                await raylet.call("return_lease", lease_id=lease_id, timeout=10)
-            except (rpc.RpcError, rpc.ConnectionLost):
-                pass
+    async def _lease_reaper_loop(self):
+        """Return leases idle past the TTL so cached workers free their
+        resources for other scheduling keys / drivers."""
+        ttl = _config.worker_lease_idle_ttl_ms / 1000
+        while True:
+            await asyncio.sleep(ttl / 2)
+            now = time.monotonic()
+            for pool in list(self._lease_pools.values()):
+                for entry in list(pool.idle):
+                    if now - entry.last_used > ttl:
+                        pool.idle.remove(entry)
+                        await self._drop_lease(pool, entry)
 
     async def _pg_node_addr(self, pg_id: bytes, bundle_index: int):
         info = await self.gcs.call("get_placement_group", pg_id=pg_id, timeout=30)

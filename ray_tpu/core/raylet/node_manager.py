@@ -49,6 +49,7 @@ class LeaseRequest:
     # of node-level availability (the bundle already holds the resources)
     pg_id: Optional[bytes] = None
     bundle_index: int = -1
+    owner_conn: object = None
 
 
 class Raylet:
@@ -93,6 +94,9 @@ class Raylet:
         self._native_pulls = 0
         # actor_id → (release token from _acquire_for-style accounting, demand)
         self._actor_resources: Dict[bytes, Tuple[object, ResourceSet]] = {}
+        # conn → lease_ids it holds (reclaimed on disconnect; lease caching
+        # on the owner side means leases outlive individual tasks)
+        self._lease_owners: Dict[object, set] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -301,10 +305,17 @@ class Raylet:
             allow_spillback=allow_spillback and pg_id is None,
             pg_id=pg_id,
             bundle_index=bundle_index,
+            owner_conn=conn,
         )
         self.pending_leases.append(lease)
         await self._dispatch()
-        return await lease.future
+        reply = await lease.future
+        if "granted" in reply and conn is not None:
+            # remember who holds it: cached leases (owner-side lease reuse)
+            # must be reclaimed when the owner's connection drops, or a
+            # crashed driver strands LEASED workers forever
+            self._lease_owners.setdefault(conn, set()).add(reply["lease_id"])
+        return reply
 
     def _acquire_for(self, lease: LeaseRequest) -> Optional[object]:
         return self._acquire(lease.demand, lease.pg_id, lease.bundle_index)
@@ -437,6 +448,8 @@ class Raylet:
 
     def handle_return_lease(self, conn, lease_id):
         entry = self.active_leases.pop(lease_id, None)
+        if conn is not None and conn in self._lease_owners:
+            self._lease_owners[conn].discard(lease_id)
         if entry is None:
             return False
         demand, worker, token = entry
@@ -666,6 +679,19 @@ class Raylet:
 
     def handle_object_store_stats(self, conn):
         return self.directory.stats()
+
+    async def on_disconnection(self, conn):
+        """An owner's connection dropped: reclaim every lease it still
+        holds and drop its queued lease requests (parity: the reference
+        raylet cancels leases on owner death)."""
+        for lease_id in list(self._lease_owners.pop(conn, ())):
+            self.handle_return_lease(None, lease_id)
+        for lr in list(self.pending_leases):
+            if lr.owner_conn is conn:
+                self.pending_leases.remove(lr)
+                if not lr.future.done():
+                    lr.future.set_result({"infeasible": True,
+                                          "reason": "owner disconnected"})
 
 
 def main():
