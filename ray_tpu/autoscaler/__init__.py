@@ -7,5 +7,11 @@ providers implement the same three methods).
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+from ray_tpu.autoscaler.tpu_pod_provider import TpuPodProvider
 
-__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]
+__all__ = [
+    "StandardAutoscaler",
+    "NodeProvider",
+    "LocalNodeProvider",
+    "TpuPodProvider",
+]
