@@ -1,0 +1,296 @@
+"""DQN: off-policy Q-learning with replay, target network, and double-Q.
+
+Parity: rllib/algorithms/dqn/ (DQN/DQNConfig; the first off-policy
+algorithm — opens the replay-buffer half of the algorithm space per
+VERDICT r3 gap #8). TPU-native shape: the whole update — double-Q target
+computation, Huber TD loss with PER importance weights, Adam step — is ONE
+jitted function over device-resident state; the replay buffer and the
+epsilon-greedy rollout loop stay host-side (they're branchy row
+bookkeeping, not tensor math).
+
+Tuned target (mirrors rllib/tuned_examples/dqn/cartpole-dqn.yaml):
+CartPole-v1 episode_reward_mean >= 150.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class DQNLearner:
+    """Jitted double-DQN update with a periodically synced target network.
+
+    The Q-network reuses the shared MLP module (models.py) with the policy
+    head read as Q-values — runner and learner exchange one pytree format
+    across every algorithm.
+    """
+
+    def __init__(
+        self,
+        obs_dim: int,
+        num_actions: int,
+        hiddens=(64, 64),
+        lr: float = 5e-4,
+        grad_clip: float = 10.0,
+        gamma: float = 0.99,
+        double_q: bool = True,
+        target_update_freq: int = 50,
+        huber_delta: float = 1.0,
+        seed: int = 0,
+        **_unused,
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import (
+            mlp_actor_critic_apply,
+            mlp_actor_critic_init,
+        )
+
+        self.gamma = gamma
+        self.double_q = double_q
+        self.target_update_freq = max(1, target_update_freq)
+        self._updates = 0
+
+        params = mlp_actor_critic_init(
+            jax.random.PRNGKey(seed), obs_dim, num_actions, tuple(hiddens)
+        )
+        self._opt = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self._state = {
+            "params": params,
+            "target": jax.tree.map(jnp.copy, params),
+            "opt_state": self._opt.init(params),
+        }
+
+        def update(state, mb):
+            def loss_fn(params):
+                q_all, _ = mlp_actor_critic_apply(params, mb["obs"])
+                qa = jnp.take_along_axis(
+                    q_all, mb["actions"][:, None], axis=-1
+                )[:, 0]
+                qn_target, _ = mlp_actor_critic_apply(
+                    state["target"], mb["next_obs"]
+                )
+                if self.double_q:
+                    qn_online, _ = mlp_actor_critic_apply(
+                        params, mb["next_obs"]
+                    )
+                    next_a = jnp.argmax(qn_online, axis=-1)
+                else:
+                    next_a = jnp.argmax(qn_target, axis=-1)
+                q_next = jnp.take_along_axis(
+                    qn_target, next_a[:, None], axis=-1
+                )[:, 0]
+                target = mb["rewards"] + self.gamma * (1.0 - mb["dones"]) * (
+                    jax.lax.stop_gradient(q_next)
+                )
+                td = qa - jax.lax.stop_gradient(target)
+                huber = jnp.where(
+                    jnp.abs(td) <= huber_delta,
+                    0.5 * td**2,
+                    huber_delta * (jnp.abs(td) - 0.5 * huber_delta),
+                )
+                loss = jnp.mean(mb["weights"] * huber)
+                return loss, (td, jnp.mean(qa))
+
+            (loss, (td, mean_q)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"])
+            updates, new_opt = self._opt.update(
+                grads, state["opt_state"], state["params"]
+            )
+            import optax as _optax
+
+            new_params = _optax.apply_updates(state["params"], updates)
+            new_state = {
+                "params": new_params,
+                "target": state["target"],
+                "opt_state": new_opt,
+            }
+            return new_state, loss, mean_q, jnp.abs(td)
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        dones = (
+            np.asarray(batch[SampleBatch.TERMINATEDS], np.float32)
+            + np.asarray(batch[SampleBatch.TRUNCATEDS], np.float32)
+        ).clip(0, 1)
+        mb = {
+            "obs": jnp.asarray(batch[SampleBatch.OBS], jnp.float32),
+            "actions": jnp.asarray(batch[SampleBatch.ACTIONS], jnp.int32),
+            "rewards": jnp.asarray(batch[SampleBatch.REWARDS], jnp.float32),
+            "next_obs": jnp.asarray(batch[SampleBatch.NEXT_OBS], jnp.float32),
+            "dones": jnp.asarray(dones),
+            "weights": jnp.asarray(
+                batch.get("weights", np.ones(len(batch), np.float32)),
+                jnp.float32,
+            ),
+        }
+        self._state, loss, mean_q, td_abs = self._update(self._state, mb)
+        self._updates += 1
+        if self._updates % self.target_update_freq == 0:
+            self._state["target"] = jax.tree.map(
+                lambda p: p, self._state["params"]
+            )
+        return {
+            "loss": float(loss),
+            "mean_q": float(mean_q),
+            "td_errors": np.asarray(td_abs),
+            "num_updates": self._updates,
+        }
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self._state["params"])
+
+    def set_weights(self, params) -> None:
+        self._state["params"] = params
+
+    def get_state(self):
+        import jax
+
+        return {
+            "state": jax.device_get(self._state),
+            "updates": self._updates,
+        }
+
+    def set_state(self, state) -> None:
+        self._state = state["state"]
+        self._updates = state["updates"]
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.lr = 5e-4
+        self.train_batch_size = 64
+        self.rollout_fragment_length = 4
+        self.num_envs_per_worker = 8
+        self.grad_clip = 10.0
+        # off-policy knobs
+        self.buffer_capacity = 50_000
+        self.prioritized_replay = True
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.learning_starts = 1_000
+        self.target_update_freq = 100
+        self.double_q = True
+        self.huber_delta = 1.0
+        self.train_intensity = 8       # learner updates per training_step
+        # epsilon-greedy exploration schedule (linear by env steps)
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_timesteps = 10_000
+
+    def training(self, **kwargs):
+        for k in (
+            "buffer_capacity", "prioritized_replay",
+            "prioritized_replay_alpha", "prioritized_replay_beta",
+            "learning_starts", "target_update_freq", "double_q",
+            "huber_delta", "train_intensity", "epsilon_start",
+            "epsilon_end", "epsilon_timesteps",
+        ):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def _runner_kwargs_extra(self) -> Dict[str, Any]:
+        return {"postprocess": "transitions", "act_mode": "epsilon_greedy"}
+
+    def _make_learner_group(self) -> LearnerGroup:
+        cfg = self.algo_config
+        buffer_cls = (
+            PrioritizedReplayBuffer if cfg.prioritized_replay else ReplayBuffer
+        )
+        buffer_kwargs = dict(capacity=cfg.buffer_capacity, seed=cfg.seed)
+        if cfg.prioritized_replay:
+            buffer_kwargs.update(
+                alpha=cfg.prioritized_replay_alpha,
+                beta=cfg.prioritized_replay_beta,
+            )
+        self.buffer = buffer_cls(**buffer_kwargs)
+        self._env_steps = 0
+        return LearnerGroup(
+            DQNLearner,
+            dict(
+                obs_dim=self.obs_dim,
+                num_actions=self.num_actions,
+                hiddens=tuple(cfg.hiddens),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                gamma=cfg.gamma,
+                double_q=cfg.double_q,
+                target_update_freq=cfg.target_update_freq,
+                huber_delta=cfg.huber_delta,
+                seed=cfg.seed,
+            ),
+            mode=cfg.learner_mode,
+            remote_options=cfg.learner_remote_options,
+        )
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        eps = self._epsilon()
+
+        # ---- collect one fragment per runner into the replay buffer
+        if self.workers:
+            import ray_tpu
+
+            weights_ref = ray_tpu.put(self._weights)
+            outs = ray_tpu.get([
+                w.sample.remote(
+                    cfg.rollout_fragment_length, weights_ref, epsilon=eps
+                )
+                for w in self.workers
+            ])
+        else:
+            outs = [self.local_runner.sample(
+                cfg.rollout_fragment_length, self._weights, epsilon=eps
+            )]
+        for batch, metrics in outs:
+            self.buffer.add(batch)
+            self._env_steps += len(batch)
+            self._merge_episode_metrics(metrics)
+
+        # ---- learn from replay once warm
+        learn_metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.train_intensity):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                m = self.learner_group.update(mb)
+                td = m.pop("td_errors", None)
+                if td is not None and hasattr(self.buffer, "update_priorities"):
+                    self.buffer.update_priorities(mb["batch_indexes"], td)
+                learn_metrics = m
+            self._weights = self.learner_group.get_weights()
+
+        stats = self._episode_stats()
+        stats.update(learn_metrics)
+        stats["epsilon"] = eps
+        stats["buffer_size"] = len(self.buffer)
+        stats["timesteps_this_iter"] = sum(len(b) for b, _ in outs)
+        return stats

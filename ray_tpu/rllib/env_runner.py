@@ -39,8 +39,10 @@ class EnvRunner:
         seed: int = 0,
         worker_index: int = 0,
         postprocess: str = "gae",
+        act_mode: str = "categorical",
     ):
         import jax
+        import jax.numpy as jnp
 
         self.env = make_vector_env(env, num_envs)
         self.gamma = gamma
@@ -50,7 +52,14 @@ class EnvRunner:
         # "vtrace": time-major [T, N] rows + behavior logp + bootstrap obs —
         # the learner computes advantages itself (IMPALA; the actor's value
         # head is stale by design there).
+        # "transitions": flat (obs, action, reward, next_obs, done) rows for
+        # replay-buffer algorithms (DQN and friends).
         self.postprocess = postprocess
+        # "categorical": sample from the policy head's distribution.
+        # "epsilon_greedy": the policy head is Q-VALUES; argmax with
+        # epsilon-random exploration (pass epsilon to sample()).
+        self.act_mode = act_mode
+        self.epsilon = 1.0
         self._rng_key = jax.random.PRNGKey(seed * 10_007 + worker_index)
         self.params = mlp_actor_critic_init(
             self._rng_key, self.env.obs_dim, self.env.num_actions, hiddens
@@ -62,6 +71,14 @@ class EnvRunner:
             logp = categorical_logp(logits, actions)
             return actions, logp, value
 
+        def _act_eps(params, obs, key, epsilon):
+            q, _ = mlp_actor_critic_apply(params, obs)
+            k1, k2 = jax.random.split(key)
+            greedy = jnp.argmax(q, axis=-1)
+            rand = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+            explore = jax.random.uniform(k2, greedy.shape) < epsilon
+            return jnp.where(explore, rand, greedy)
+
         def _value(params, obs):
             return mlp_actor_critic_apply(params, obs)[1]
 
@@ -70,6 +87,7 @@ class EnvRunner:
         # jax.default_device(cpu) so uncommitted numpy inputs land there
         self._cpu = jax.devices("cpu")[0]
         self._act = jax.jit(_act)
+        self._act_eps = jax.jit(_act_eps)
         self._value = jax.jit(_value)
 
         self._obs = self.env.reset(seed=seed * 997 + worker_index)
@@ -92,7 +110,8 @@ class EnvRunner:
         return self.env.obs_dim, self.env.num_actions
 
     def sample(
-        self, num_steps: int, params: Optional[Any] = None
+        self, num_steps: int, params: Optional[Any] = None,
+        epsilon: Optional[float] = None,
     ) -> Tuple[SampleBatch, Dict[str, Any]]:
         """Roll `num_steps` env steps per lane; return (batch, metrics).
 
@@ -103,6 +122,8 @@ class EnvRunner:
 
         if params is not None:
             self.params = params
+        if epsilon is not None:
+            self.epsilon = float(epsilon)
         ctx = jax.default_device(self._cpu)
         with ctx:
             return self._sample(num_steps)
@@ -121,17 +142,36 @@ class EnvRunner:
         trunc_buf = np.empty((T, N), bool)
         eps_buf = np.empty((T, N), np.int64)
 
+        transitions = self.postprocess == "transitions"
+        next_obs_buf = (
+            np.empty((T, N, self.env.obs_dim), np.float32)
+            if transitions else None
+        )
+
         obs = self._obs
         for t in range(T):
             self._rng_key, sub = jax.random.split(self._rng_key)
-            actions, logp, value = self._act(self.params, obs, sub)
-            actions = np.asarray(actions)
+            if self.act_mode == "epsilon_greedy":
+                actions = np.asarray(
+                    self._act_eps(self.params, obs, sub, self.epsilon)
+                )
+                logp_buf[t] = 0.0
+                vf_buf[t] = 0.0
+            else:
+                actions, logp, value = self._act(self.params, obs, sub)
+                actions = np.asarray(actions)
+                logp_buf[t] = np.asarray(logp)
+                vf_buf[t] = np.asarray(value)
             obs_buf[t] = obs
             act_buf[t] = actions
-            logp_buf[t] = np.asarray(logp)
-            vf_buf[t] = np.asarray(value)
             eps_buf[t] = self._eps_id
             obs, rewards, terminated, truncated = self.env.step(actions)
+            if transitions:
+                # NB: at auto-reset boundaries this is the RESET obs, not the
+                # true terminal successor — harmless for bootstrapping since
+                # the (1 - done) mask zeroes those targets (truncations are
+                # treated as terminal, the standard replay shortcut).
+                next_obs_buf[t] = obs
             rew_buf[t] = rewards
             term_buf[t] = terminated
             trunc_buf[t] = truncated
@@ -154,6 +194,21 @@ class EnvRunner:
             "num_env_steps": T * N,
             "worker_index": self.worker_index,
         }
+        if transitions:
+            def flat(x):
+                return x.reshape((T * N,) + x.shape[2:])
+
+            batch = SampleBatch({
+                SampleBatch.OBS: flat(obs_buf),
+                SampleBatch.ACTIONS: flat(act_buf),
+                SampleBatch.REWARDS: flat(rew_buf),
+                SampleBatch.NEXT_OBS: flat(next_obs_buf),
+                SampleBatch.TERMINATEDS: flat(term_buf),
+                SampleBatch.TRUNCATEDS: flat(trunc_buf),
+                SampleBatch.EPS_ID: flat(eps_buf),
+            })
+            return batch, metrics
+
         if self.postprocess == "vtrace":
             batch = SampleBatch({
                 SampleBatch.OBS: obs_buf,              # [T, N, D]

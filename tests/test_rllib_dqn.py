@@ -1,0 +1,115 @@
+"""DQN + replay buffers.
+
+Parity: rllib/algorithms/dqn/ + rllib/utils/replay_buffers/ — the
+off-policy path (VERDICT r3 gap #8). Learning regression mirrors
+rllib/tuned_examples/dqn/cartpole-dqn.yaml (reward >= 150).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _batch(n, base=0):
+    return SampleBatch({
+        SampleBatch.OBS: np.arange(n, dtype=np.float32)[:, None] + base,
+        SampleBatch.ACTIONS: np.zeros(n, np.int64),
+        SampleBatch.REWARDS: np.arange(n, dtype=np.float32) + base,
+    })
+
+
+class TestReplayBuffers:
+    def test_ring_wraparound_and_uniform_sample(self):
+        buf = ReplayBuffer(capacity=8, seed=0)
+        buf.add(_batch(6))
+        assert len(buf) == 6
+        buf.add(_batch(6, base=100))  # wraps: keeps the latest 8
+        assert len(buf) == 8
+        s = buf.sample(64)
+        assert len(s) == 64
+        # rows 4..5 of the first batch were overwritten by wraparound
+        assert set(np.unique(s[SampleBatch.REWARDS])) <= (
+            {4.0, 5.0} | {100.0 + i for i in range(6)}
+        )
+
+    def test_prioritized_sampling_bias_and_weights(self):
+        buf = PrioritizedReplayBuffer(capacity=64, alpha=1.0, beta=1.0, seed=1)
+        buf.add(_batch(32))
+        # row 7 gets 100x the priority of everything else
+        prios = np.ones(32)
+        prios[7] = 100.0
+        buf.update_priorities(np.arange(32), prios)
+        s = buf.sample(512)
+        counts = np.bincount(s["batch_indexes"], minlength=32)
+        assert counts[7] > 0.5 * 512  # ~76% expected mass
+        # importance weights: the over-sampled row has the SMALLEST weight
+        w_by_idx = {}
+        for i, w in zip(s["batch_indexes"], s["weights"]):
+            w_by_idx[int(i)] = float(w)
+        assert w_by_idx[7] == min(w_by_idx.values())
+        assert max(w_by_idx.values()) <= 1.0 + 1e-6
+
+    def test_priority_update_changes_distribution(self):
+        buf = PrioritizedReplayBuffer(capacity=16, alpha=1.0, seed=2)
+        buf.add(_batch(16))
+        buf.update_priorities(np.arange(16), np.full(16, 1e-6))
+        buf.update_priorities(np.asarray([3]), np.asarray([1000.0]))
+        s = buf.sample(128)
+        assert np.mean(s["batch_indexes"] == 3) > 0.9
+
+
+def test_dqn_learner_reduces_td_loss():
+    """The jitted double-Q update fits a tiny synthetic MDP batch."""
+    from ray_tpu.rllib.algorithms.dqn import DQNLearner
+
+    rng = np.random.default_rng(0)
+    n, obs_dim, num_actions = 256, 4, 2
+    obs = rng.normal(size=(n, obs_dim)).astype(np.float32)
+    batch = SampleBatch({
+        SampleBatch.OBS: obs,
+        SampleBatch.ACTIONS: rng.integers(0, num_actions, n),
+        SampleBatch.REWARDS: obs[:, 0],      # learnable signal
+        SampleBatch.NEXT_OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        SampleBatch.TERMINATEDS: np.ones(n, bool),   # pure regression
+        SampleBatch.TRUNCATEDS: np.zeros(n, bool),
+    })
+    learner = DQNLearner(obs_dim, num_actions, hiddens=(32,), lr=3e-3, seed=0)
+    first = learner.update(batch)["loss"]
+    for _ in range(60):
+        last = learner.update(batch)
+    assert last["loss"] < first * 0.3, (first, last["loss"])
+    assert last["td_errors"].shape == (n,)
+    assert last["num_updates"] == 61
+
+
+def test_dqn_learns_cartpole():
+    """Learning regression (rllib/tuned_examples/dqn/cartpole-dqn.yaml:
+    episode_reward_mean >= 150): inline runner, prioritized replay,
+    double-Q, epsilon decay."""
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1", num_envs_per_worker=8)
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=8)
+        .training(
+            lr=1e-3,
+            train_batch_size=64,
+            learning_starts=500,
+            target_update_freq=60,
+            train_intensity=8,
+            epsilon_timesteps=6_000,
+            hiddens=(64, 64),
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    best = -np.inf
+    for i in range(500):
+        res = algo.train()
+        best = max(best, res.get("episode_reward_mean", -np.inf))
+        if best >= 150:
+            break
+    assert best >= 150, f"DQN failed to learn CartPole: best={best}"
