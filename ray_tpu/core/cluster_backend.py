@@ -275,6 +275,11 @@ class ClusterBackend(Backend):
         return self.core.put(value)
 
     def get(self, refs, timeout):
+        # nested get inside a task (worker mode): advise the raylet so our
+        # lease's CPU frees while we block (see worker_main.get_blocking)
+        blocking_get = getattr(self.core, "get_blocking", None)
+        if blocking_get is not None:
+            return blocking_get(refs, timeout)
         return self.core.get(refs, timeout)
 
     def wait(self, refs, num_returns, timeout, fetch_local):

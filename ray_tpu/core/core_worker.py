@@ -101,7 +101,7 @@ class _LeasePool:
     def __init__(self):
         self.idle: List[_LeaseEntry] = []
         self.total = 0
-        self.error: Optional[BaseException] = None  # latest failed fetch
+        self.error: Optional[BaseException] = None  # latest failed request
         from collections import deque
 
         self._waiters: "deque" = deque()
@@ -795,46 +795,85 @@ class CoreWorker:
         return result
 
     async def _acquire_lease(self, pool: "_LeasePool", spec) -> "_LeaseEntry":
-        """Take an idle cached lease, spawning background lease fetchers as
-        needed. Submitters never await a raylet grant directly — a queued
-        grant (resources busy) must not strand ITS task behind faster peers
-        flowing through already-cached leases; fetched entries join the
-        shared pool and any waiter takes them."""
+        """Take an idle cached lease, or request a fresh one.
+
+        Every in-flight lease request belongs to a submitter that is
+        actively awaiting it — never a detached fetcher. (An earlier design
+        used background fetchers feeding the pool; their ownerless requests
+        outlived demand bursts, sat queued at the raylet, and FIFO grant
+        order then starved other scheduling keys into cluster-wide livelock
+        — caught by the shuffle tests.) Granted entries still land in the
+        SHARED pool before being re-popped, so a grant arriving while a
+        cached entry freed up serves whichever waiter is first.
+        """
+        import uuid as _uuid
+
         while True:
             while pool.idle:
                 entry = pool.idle.pop()
                 if entry.conn is not None and not entry.conn.closed:
                     return entry
                 await self._drop_lease(pool, entry)
-            if pool.error is not None:
-                err, pool.error = pool.error, None
-                raise err
-            self._maybe_spawn_fetch(pool, spec)
-            await pool.wait(timeout=0.5)
+            if pool.total >= _config.max_pending_lease_requests_per_scheduling_key:
+                await pool.wait(timeout=0.5)
+                continue
+            # race a fresh lease request against a cached entry freeing up;
+            # the loser is cleaned up (queued request → cancel RPC; grant
+            # that slips through anyway → pooled for the next waiter)
+            pool.total += 1
+            req_id = _uuid.uuid4().hex
+            holder: Dict[str, Any] = {}
+            req = asyncio.ensure_future(
+                self._request_new_lease(spec, req_id=req_id, holder=holder)
+            )
+            waiter = asyncio.get_running_loop().create_future()
+            pool._waiters.append(waiter)
+            await asyncio.wait(
+                {req, waiter}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if req.done():
+                if not waiter.done():
+                    waiter.cancel()
+                try:
+                    entry = req.result()
+                except BaseException:
+                    pool.total -= 1
+                    pool.wake()
+                    raise
+                if entry is None:  # canceled under us (shouldn't happen here)
+                    pool.total -= 1
+                    continue
+                pool.idle.append(entry)
+                pool.wake()
+                continue  # re-pop: usually our own grant, FIFO otherwise
+            # a cached entry freed first: take it, retire our request
+            asyncio.ensure_future(self._settle_request(pool, req, req_id, holder))
+            continue
 
-    def _maybe_spawn_fetch(self, pool: "_LeasePool", spec) -> None:
-        if pool.total >= _config.max_pending_lease_requests_per_scheduling_key:
-            return
-        pool.total += 1
-
-        async def fetch():
+    async def _settle_request(self, pool: "_LeasePool", req, req_id, holder):
+        """Background cleanup for a lease request whose submitter was served
+        by the cache first: cancel it at the raylet; if the grant already
+        raced through, pool the entry (it will serve a waiter or TTL out)."""
+        raylet = holder.get("raylet")
+        if raylet is not None and not raylet.closed:
             try:
-                entry = await self._request_new_lease(spec)
-            except BaseException as e:  # noqa: BLE001 - surface to waiters
-                pool.total -= 1
-                pool.error = e
-                pool.wake_all()  # every waiter re-checks (error/refetch)
-                return
-            if not pool._waiters and pool.idle:
-                # demand already drained (burst over): a queued grant that
-                # lands now would only churn through the idle-TTL reaper —
-                # hand it straight back
-                await self._drop_lease(pool, entry)
-                return
-            pool.idle.append(entry)
+                await raylet.call("cancel_lease_request", req_id=req_id,
+                                  timeout=30)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+        try:
+            entry = await req
+        except BaseException:  # noqa: BLE001 - request failed: slot freed
+            pool.total -= 1
             pool.wake()
+            return
+        if entry is None:      # canceled cleanly
+            pool.total -= 1
+            pool.wake()
+            return
+        pool.idle.append(entry)
+        pool.wake()
 
-        asyncio.ensure_future(fetch())
 
     async def _drop_lease(self, pool, entry: "_LeaseEntry"):
         pool.total -= 1
@@ -846,7 +885,12 @@ class CoreWorker:
         except (rpc.RpcError, rpc.ConnectionLost):
             pass
 
-    async def _request_new_lease(self, spec: ts.TaskSpec) -> "_LeaseEntry":
+    async def _request_new_lease(
+        self, spec: ts.TaskSpec, req_id: Optional[str] = None,
+        holder: Optional[dict] = None,
+    ) -> Optional["_LeaseEntry"]:
+        """holder (when given) is updated with the raylet conn currently
+        holding the queued request, so a canceller can reach it."""
         raylet = await self._ensure_raylet()
         raylet_addr = self.raylet_address
         if spec.placement_group_id is not None:
@@ -860,12 +904,15 @@ class CoreWorker:
                     raise exc.RayTpuError(f"placement-group node {addr} gone")
                 raylet, raylet_addr = conn, addr
         for _hop in range(8):  # spillback chain bound
+            if holder is not None:
+                holder["raylet"] = raylet
             try:
                 reply = await raylet.call(
                     "request_lease",
                     resources=spec.resources,
                     pg_id=spec.placement_group_id,
                     bundle_index=spec.placement_group_bundle_index,
+                    req_id=req_id,
                     timeout=None,
                 )
             except rpc.ConnectionLost as e:
@@ -874,6 +921,8 @@ class CoreWorker:
                 raise exc.WorkerCrashedError(
                     f"raylet {raylet_addr} lost during lease: {e}"
                 ) from e
+            if "canceled" in reply:
+                return None
             if "granted" in reply:
                 worker_addr = reply["granted"]
                 conn = await self._conn_to(worker_addr, kind="worker")

@@ -50,6 +50,7 @@ class LeaseRequest:
     pg_id: Optional[bytes] = None
     bundle_index: int = -1
     owner_conn: object = None
+    req_id: Optional[str] = None   # owner-side id for cancellation
 
 
 class Raylet:
@@ -97,6 +98,14 @@ class Raylet:
         # conn → lease_ids it holds (reclaimed on disconnect; lease caching
         # on the owner side means leases outlive individual tasks)
         self._lease_owners: Dict[object, set] = {}
+        # leases whose resources are RELEASED because their worker reported
+        # itself blocked in ray.get (NotifyDirectCallTaskBlocked parity):
+        # blocked workers must not hold CPU their upstream tasks need, or
+        # task-waits-for-task pipelines deadlock at the worker cap
+        self._blocked_leases: set = set()
+        # lease_id → (pg_id, bundle_index) for PG leases: blocked-worker
+        # re-acquire must draw from the SAME bundle, not node availability
+        self._lease_pg: Dict[str, Tuple[Optional[bytes], int]] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -215,10 +224,12 @@ class Raylet:
         logger.warning("re-registered with GCS at %s", self.gcs_address)
 
     async def _poll_loop(self):
+        self._poll_ticks = 0
         while True:
             try:
                 await self.pool.poll_deaths()
                 await self._dispatch()
+                self._poll_ticks += 1
             except Exception:  # noqa: BLE001 - the loop must survive anything
                 logger.exception("raylet poll loop error")
             await asyncio.sleep(0.05)
@@ -254,6 +265,9 @@ class Raylet:
         g_spill = metrics_api.Gauge(
             "object_store_num_spilled", "objects spilled to disk"
         )
+        g_ticks = metrics_api.Gauge(
+            "raylet_dispatch_ticks", "poll-loop iterations completed"
+        )
         period = max(_config.metrics_report_interval_ms, 100) / 1000
         while True:
             try:
@@ -268,6 +282,12 @@ class Raylet:
                 g_bytes.set(st.get("used_bytes", 0))
                 g_objs.set(st.get("num_objects", 0))
                 g_spill.set(st.get("num_spilled", 0))
+                g_ticks.set(getattr(self, "_poll_ticks", -1))
+                for k, v in getattr(self, "_disp", {}).items():
+                    metrics_api.Gauge(
+                        f"raylet_dispatch_{k}",
+                        "scheduler dispatch decisions since start",
+                    ).set(v)
                 samples = metrics_api.get_registry().collect()
                 if samples and self.gcs is not None and not self.gcs.closed:
                     await self.gcs.notify(
@@ -282,8 +302,59 @@ class Raylet:
             await asyncio.sleep(period)
 
     # ----------------------------------------------------------- scheduling
+    def handle_worker_blocked(self, conn, worker_id: str):
+        """A leased worker is blocking in get(): release its lease's
+        resources and let the cap spawn replacements so its dependencies
+        can run (reference: NotifyDirectCallTaskBlocked)."""
+        w = self.pool.get_by_worker_id(worker_id)
+        if w is None or not w.lease_id:
+            return False
+        entry = self.active_leases.get(w.lease_id)
+        if entry is None or w.lease_id in self._blocked_leases:
+            return False
+        demand, worker, token = entry
+        self._release_token(token, demand)
+        self._blocked_leases.add(w.lease_id)
+        return True
+
+    def handle_worker_unblocked(self, conn, worker_id: str):
+        """The worker's get() returned: re-acquire its resources when
+        available; if the node is briefly oversubscribed, the lease stays
+        marked so return_lease won't double-release."""
+        w = self.pool.get_by_worker_id(worker_id)
+        if w is None or not w.lease_id:
+            return False
+        if w.lease_id not in self._blocked_leases:
+            return False
+        entry = self.active_leases.get(w.lease_id)
+        if entry is None:
+            self._blocked_leases.discard(w.lease_id)
+            return False
+        demand, worker, _ = entry
+        pg_id, bundle_index = self._lease_pg.get(w.lease_id, (None, -1))
+        token = self._acquire(demand, pg_id, bundle_index)
+        if token is not None:
+            self.active_leases[w.lease_id] = (demand, worker, token)
+            self._blocked_leases.discard(w.lease_id)
+        # else: stay blocked-marked; resources re-sync at return_lease
+        return True
+
+    def handle_cancel_lease_request(self, conn, req_id: str):
+        """Owner no longer needs a QUEUED lease request (its demand was
+        served by a cached lease). Parity: the reference's lease-request
+        cancellation (ReplyCanceled) — without it, stale queued requests
+        pile up and FIFO grant order starves other scheduling keys."""
+        for lr in self.pending_leases:
+            if lr.req_id == req_id:
+                self.pending_leases.remove(lr)
+                if not lr.future.done():
+                    lr.future.set_result({"canceled": True})
+                return True
+        return False  # already granted (or unknown): caller pools the grant
+
     async def handle_request_lease(
-        self, conn, resources, allow_spillback=True, pg_id=None, bundle_index=-1,
+        self, conn, resources, allow_spillback=True, pg_id=None,
+        bundle_index=-1, req_id=None,
     ):
         """Owner asks for a worker lease. Replies:
         {granted: worker_addr, lease_id} | {spillback: raylet_addr} |
@@ -306,6 +377,7 @@ class Raylet:
             pg_id=pg_id,
             bundle_index=bundle_index,
             owner_conn=conn,
+            req_id=req_id,
         )
         self.pending_leases.append(lease)
         await self._dispatch()
@@ -384,8 +456,15 @@ class Raylet:
         can never fit resolve via spillback/timeout without blocking others;
         fit-able leases grant FIFO as resources + idle workers allow."""
         now = time.monotonic()
+        # dispatch decision counters (exported as raylet_dispatch_* — the
+        # r4 lease-livelock was diagnosed from exactly these)
+        if not hasattr(self, "_disp"):
+            self._disp = {"grants": 0, "skipped_no_worker": 0,
+                          "skipped_no_resources": 0, "done": 0, "seen": 0}
         for lease in list(self.pending_leases):
+            self._disp["seen"] += 1
             if lease.future.done():
+                self._disp["done"] += 1
                 self.pending_leases.remove(lease)
                 continue
             never_fits_here = lease.pg_id is None and not self.total.fits(
@@ -406,11 +485,18 @@ class Raylet:
                 continue
             idle = self.pool.idle_workers()
             if not idle:
+                self._disp["skipped_no_worker"] += 1
                 starting = sum(
                     1 for w in self.pool.workers.values() if w.state == "STARTING"
                 )
+                blocked_workers = {
+                    self.active_leases[lid][1].startup_token
+                    for lid in self._blocked_leases
+                    if lid in self.active_leases
+                }
                 alive = sum(
-                    1 for w in self.pool.workers.values() if w.state != DEAD
+                    1 for w in self.pool.workers.values()
+                    if w.state != DEAD and w.startup_token not in blocked_workers
                 )
                 # spawn at most one per tick, only when the pipeline of
                 # starting workers doesn't already cover the queue
@@ -419,6 +505,7 @@ class Raylet:
                 continue
             token = self._acquire_for(lease)
             if token is None:
+                self._disp["skipped_no_resources"] += 1
                 # resources busy: after a grace period, offload to a peer
                 # with free capacity NOW (never to another busy node)
                 if lease.allow_spillback and now - lease.queued_at >= 0.5:
@@ -433,6 +520,9 @@ class Raylet:
             worker.state = LEASED
             worker.lease_id = lease.lease_id
             self.active_leases[lease.lease_id] = (lease.demand, worker, token)
+            self._disp["grants"] += 1
+            if lease.pg_id is not None:
+                self._lease_pg[lease.lease_id] = (lease.pg_id, lease.bundle_index)
             self.pending_leases.remove(lease)
             lease.future.set_result(
                 {"granted": worker.address, "lease_id": lease.lease_id,
@@ -453,7 +543,11 @@ class Raylet:
         if entry is None:
             return False
         demand, worker, token = entry
-        self._release_token(token, demand)
+        self._lease_pg.pop(lease_id, None)
+        if lease_id in self._blocked_leases:
+            self._blocked_leases.discard(lease_id)  # already released
+        else:
+            self._release_token(token, demand)
         if worker.state == LEASED:
             worker.state = IDLE
             worker.lease_id = None

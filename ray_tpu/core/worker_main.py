@@ -86,6 +86,30 @@ class WorkerAgent(CoreWorker):
             )
         return self._applier
 
+
+    # ------------------------------------------------- blocked-worker plane
+    # Parity: the reference's NotifyDirectCallTaskBlocked/Unblocked — a task
+    # blocking in ray.get must release its lease's CPU so the tasks it waits
+    # on can be scheduled; without this, tasks-that-get-tasks deadlock once
+    # blocked tasks occupy every worker (hit by the shuffle pipeline: reduce
+    # tasks held all workers while their upstream map tasks starved).
+    def _notify_blocked(self, blocked: bool) -> None:
+        if self.raylet is None or self.raylet.closed:
+            return
+        method = "worker_blocked" if blocked else "worker_unblocked"
+        try:
+            self.io.spawn(self.raylet.notify(method, worker_id=self.worker_id.hex()))
+        except Exception:  # noqa: BLE001 - advisory only
+            pass
+
+    def get_blocking(self, refs, timeout):
+        """get() that tells the raylet this worker is blocked meanwhile."""
+        self._notify_blocked(True)
+        try:
+            return self.get(refs, timeout)
+        finally:
+            self._notify_blocked(False)
+
     def _execute(self, spec: ts.TaskSpec) -> dict:
         applied = False
         self._record_task_event(spec, "RUNNING")
@@ -97,7 +121,8 @@ class WorkerAgent(CoreWorker):
                 self._env_applier().apply(spec.runtime_env)
             fn = self.io.run(self.load_function(spec.fn_id))
             args, kwargs = ts.decode_args(
-                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+                spec.args, spec.kwargs,
+                lambda refs: self.get_blocking(refs, None),
             )
             attempts = 0
             while True:

@@ -287,3 +287,53 @@ def test_groupby_map_groups_shuffled(ray_start_regular):
     out = ds.groupby("k").map_groups(spread, num_partitions=3)
     rows = {int(r["k"]): float(r["spread"]) for r in out.take_all()}
     assert rows == {k: 95.0 for k in range(5)}
+
+
+def test_preprocessors(ray_start_local):
+    """fit/transform layer (parity: ray/data/preprocessors/)."""
+    from ray_tpu.data.preprocessors import (
+        BatchMapper,
+        Chain,
+        LabelEncoder,
+        MinMaxScaler,
+        StandardScaler,
+    )
+
+    rows = [{"x": float(i), "y": float(i % 4), "label": ["a", "b", "c"][i % 3]}
+            for i in range(64)]
+    ds = rd.from_items(rows, parallelism=4)
+
+    sc = StandardScaler(["x"]).fit(ds)
+    out = np.concatenate([b["x"] for b in [
+        __import__("ray_tpu").get(r) for r in sc.transform(ds).iter_block_refs()
+    ]])
+    assert abs(out.mean()) < 1e-6 and abs(out.std() - 1.0) < 1e-2
+
+    mm = MinMaxScaler(["y"]).fit(ds)
+    vals = [r["y"] for r in mm.transform(ds).take_all()]
+    assert min(vals) == 0.0 and max(vals) == 1.0
+
+    le = LabelEncoder("label").fit(ds)
+    codes = {r["label"] for r in le.transform(ds).take_all()}
+    assert codes == {0, 1, 2}
+    assert list(le.classes_) == ["a", "b", "c"]
+
+    chained = Chain(
+        StandardScaler(["x"]),
+        BatchMapper(lambda b: {**b, "x": np.asarray(b["x"]) * 2.0}),
+    ).fit_transform(ds)
+    xs = np.asarray([r["x"] for r in chained.take_all()])
+    assert abs(xs.std() - 2.0) < 2e-2
+
+    with pytest.raises(RuntimeError, match="must be fit"):
+        StandardScaler(["x"]).transform(ds)
+
+
+def test_dataset_stats(ray_start_local):
+    """Per-op execution stats (parity: Dataset.stats / _internal/stats.py)."""
+    ds = rd.range(100, parallelism=4).map_batches(lambda b: b)
+    assert "not been executed" in ds.stats()
+    _ = ds.take_all()
+    s = ds.stats()
+    assert "Read" in s and "MapBatches" in s
+    assert "blocks=4" in s
