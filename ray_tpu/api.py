@@ -79,7 +79,14 @@ def init(
             local_mode = os.environ.get("RAY_TPU_LOCAL_MODE", "0") == "1"
         if namespace:
             _worker.namespace = namespace
-        if local_mode:
+        if address and address.startswith("ray://"):
+            # thin client: proxy everything to a ClientServer on the head
+            # (parity: ray.init("ray://...") → util/client/worker.py:81)
+            from ray_tpu.client import ClientBackend
+
+            _worker.backend = ClientBackend(address)
+            _worker.mode = "client"
+        elif local_mode:
             from ray_tpu.core.local_backend import LocalBackend
 
             _worker.backend = LocalBackend()

@@ -1,0 +1,165 @@
+"""Ray Client: thin drivers over `ray://host:port`.
+
+Parity: python/ray/util/client/ (client-side Worker, worker.py:81) — a
+driver that does NOT join the cluster: it holds lightweight refs and
+proxies every operation to a ClientServer (client/server.py) that owns the
+real objects and actors. `ray_tpu.init("ray://head:10001")` selects this
+backend transparently; the entire public API (remote/get/put/wait/actors/
+named actors/kill) works unchanged.
+
+Refs cross the wire as opaque object-id markers: ClientObjectRef pickles
+to a marker the server resolves against this connection's registry, so
+refs nested anywhere inside task arguments rehydrate server-side.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import rpc
+from ray_tpu.core.backend import Backend
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.options import RemoteOptions
+from ray_tpu.core.refs import ObjectRef
+from ray_tpu.client.server import ClientServer  # noqa: F401
+
+__all__ = ["ClientBackend", "ClientServer"]
+
+
+class ClientObjectRef(ObjectRef):
+    """A ref held by a thin client: just an id; pickles to a server-side
+    marker so it can ride inside task arguments."""
+
+    def __reduce__(self):
+        return (_marker_from_hex, (self.id.hex(),))
+
+
+def _marker_from_hex(oid_hex: str):
+    # On the SERVER this must resolve to the real ref (we're mid-unpickle
+    # of a client payload); on a client it rebuilds a ClientObjectRef.
+    from ray_tpu.client import server as srv_mod
+
+    if getattr(srv_mod._resolving, "registry", None) is not None:
+        return srv_mod._resolve_marker(oid_hex)
+    return ClientObjectRef(ObjectID.from_hex(oid_hex))
+
+
+class ClientBackend(Backend):
+    def __init__(self, address: str):
+        # "ray://host:port" → "host:port"
+        if address.startswith("ray://"):
+            address = address[len("ray://"):]
+        self.address = address
+        self.io = rpc.EventLoopThread(name="ray-client-io")
+        self._conn = self.io.run(
+            rpc.connect(address, name="client->server", retries=30)
+        )
+        self.info = self._call("connection_info")
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="client-future"
+        )
+        # release server-held refs when the last local handle dies — without
+        # this every put/task result stays pinned in the server registry for
+        # the connection's whole lifetime
+        from ray_tpu.core import refs as refs_mod
+
+        refs_mod.set_on_zero_callback(self._on_ref_zero)
+
+    def _on_ref_zero(self, oid, owner_addr, task_id) -> None:
+        try:
+            self.io.spawn(
+                self._conn.notify("release", oid_hexes=[oid.hex()])
+            )
+        except Exception:  # noqa: BLE001 - best-effort GC
+            pass
+
+    def _call(self, method: str, timeout: Optional[float] = None, **kw):
+        return self.io.run(self._conn.call(method, timeout=timeout, **kw))
+
+    # ------------------------------------------------------------- tasks
+    def submit_task(self, func, args, kwargs, options: RemoteOptions):
+        payload = cloudpickle.dumps((func, args, kwargs, options))
+        hexes = self._call("submit_task", payload=payload)
+        return [ClientObjectRef(ObjectID.from_hex(h)) for h in hexes]
+
+    def create_actor(self, cls, args, kwargs, options: RemoteOptions):
+        payload = cloudpickle.dumps((cls, args, kwargs, options))
+        aid = self._call("create_actor", payload=payload)
+        return ActorID(aid)
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
+        payload = cloudpickle.dumps((args, kwargs, options))
+        hexes = self._call(
+            "submit_actor_task",
+            actor_id=actor_id.binary(),
+            method_name=method_name,
+            payload=payload,
+        )
+        return [ClientObjectRef(ObjectID.from_hex(h)) for h in hexes]
+
+    # ------------------------------------------------------------ objects
+    def put(self, value: Any) -> ObjectRef:
+        h = self._call("put", blob=cloudpickle.dumps(value))
+        return ClientObjectRef(ObjectID.from_hex(h))
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        blob = self._call(
+            "get",
+            timeout=None if timeout is None else timeout + 10,
+            oid_hexes=[r.id.hex() for r in refs],
+            get_timeout=timeout,
+        )
+        return cloudpickle.loads(blob)
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        by_hex = {r.id.hex(): r for r in refs}
+        ready_h, pending_h = self._call(
+            "wait",
+            oid_hexes=list(by_hex),
+            num_returns=num_returns,
+            wait_timeout=timeout,
+            timeout=None if timeout is None else timeout + 10,
+        )
+        return [by_hex[h] for h in ready_h], [by_hex[h] for h in pending_h]
+
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        return self._pool.submit(lambda: self.get([ref], None)[0])
+
+    # ------------------------------------------------------------- control
+    def kill_actor(self, actor_id, no_restart):
+        self._call("kill_actor", actor_id=actor_id.binary(),
+                   no_restart=no_restart)
+
+    def cancel(self, ref, force, recursive):
+        pass  # server-side tasks run to completion (parity gap: cancel)
+
+    def get_named_actor(self, name: str, namespace: Optional[str]):
+        aid = self._call("get_named_actor", name=name, namespace=namespace)
+        return ActorID(aid)
+
+    def free_actor(self, actor_id) -> None:
+        pass  # server session owns actor lifetime
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._call("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._call("available_resources")
+
+    def nodes(self) -> List[dict]:
+        return self._call("nodes")
+
+    def shutdown(self) -> None:
+        from ray_tpu.core import refs as refs_mod
+
+        refs_mod.set_on_zero_callback(None)
+        try:
+            self.io.run(self._conn.close())
+        except Exception:  # noqa: BLE001
+            pass
+        self.io.stop()
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,195 @@
+"""Client server: hosts remote thin drivers (the `ray://` proxy).
+
+Parity: python/ray/util/client/server/ — the gRPC proxy whose server side
+owns the real objects and actors on behalf of thin clients
+(util/client/worker.py:81 is the client half). Here the server is an
+asyncio RPC handler (core/rpc.py plane, cluster-token auth) run inside a
+process that has joined the cluster as a driver; each client connection
+gets its own ref registry, so disconnecting a client releases everything
+it created.
+
+Wire shape per call: cloudpickle blobs. Client-side refs travel as
+`_RefMarker(oid_hex)` (ClientObjectRef.__reduce__); the server resolves
+markers against the connection's registry AT unpickle time, so refs nested
+arbitrarily deep in arguments rehydrate to the real ObjectRefs.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.core import rpc
+
+logger = logging.getLogger(__name__)
+
+# per-thread active registry for marker resolution during unpickle
+_resolving = threading.local()
+
+
+def _resolve_marker(oid_hex: str):
+    reg = getattr(_resolving, "registry", None)
+    if reg is None or oid_hex not in reg:
+        raise ValueError(f"client ref {oid_hex[:16]} unknown to this session")
+    return reg[oid_hex]
+
+
+class ClientServer:
+    """One per head/proxy process; serves any number of thin clients."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            raise RuntimeError(
+                "ClientServer requires an initialized cluster driver "
+                "(call ray_tpu.init() first)"
+            )
+        self._ray = ray_tpu
+        self.server = rpc.RpcServer(self, host=host, port=port)
+        # conn -> {oid_hex: ObjectRef}; keeps client objects alive
+        self._refs: Dict[Any, Dict[str, Any]] = {}
+        self._actors: Dict[Any, Dict[bytes, Any]] = {}
+        self._loop_thread: Optional[rpc.EventLoopThread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> str:
+        self._loop_thread = rpc.EventLoopThread(name="client-server")
+        self._loop_thread.run(self._start_async())
+        return self.address
+
+    async def _start_async(self):
+        await self.server.start()
+        logger.info("client server on %s", self.server.address)
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    def stop(self):
+        if self._loop_thread:
+            self._loop_thread.run(self.server.close())
+            self._loop_thread.stop()
+
+    # -------------------------------------------------------------- helpers
+    def _registry(self, conn) -> Dict[str, Any]:
+        return self._refs.setdefault(conn, {})
+
+    def _loads(self, conn, blob: bytes):
+        _resolving.registry = self._registry(conn)
+        try:
+            return cloudpickle.loads(blob)
+        finally:
+            _resolving.registry = None
+
+    def _register(self, conn, refs) -> list:
+        reg = self._registry(conn)
+        out = []
+        for r in refs:
+            reg[r.id.hex()] = r
+            out.append(r.id.hex())
+        return out
+
+    def on_disconnection(self, conn):
+        self._refs.pop(conn, None)
+        for handle in (self._actors.pop(conn, {}) or {}).values():
+            try:
+                self._ray.kill(handle)
+            except Exception:  # noqa: BLE001 - best effort cleanup
+                pass
+
+    # -------------------------------------------------------------- handlers
+    def handle_connection_info(self, conn):
+        return {
+            "ray_version": __import__("ray_tpu").__version__,
+            "num_clients": len(self._refs) + 1,
+        }
+
+    def handle_put(self, conn, blob: bytes):
+        ref = self._ray.put(self._loads(conn, blob))
+        return self._register(conn, [ref])[0]
+
+    async def handle_get(self, conn, oid_hexes: list, get_timeout=None):
+        # blocking cluster call → executor thread: a slow get from one
+        # client must not stall the shared server loop (all other clients)
+        reg = self._registry(conn)
+        refs = [reg[h] for h in oid_hexes]
+        loop = __import__("asyncio").get_running_loop()
+        values = await loop.run_in_executor(
+            None, lambda: self._ray.get(refs, timeout=get_timeout)
+        )
+        return cloudpickle.dumps(values)
+
+    async def handle_wait(self, conn, oid_hexes: list, num_returns: int,
+                          wait_timeout=None):
+        reg = self._registry(conn)
+        refs = [reg[h] for h in oid_hexes]
+        loop = __import__("asyncio").get_running_loop()
+        ready, pending = await loop.run_in_executor(
+            None, lambda: self._ray.wait(
+                refs, num_returns=num_returns, timeout=wait_timeout
+            )
+        )
+        return ([r.id.hex() for r in ready], [r.id.hex() for r in pending])
+
+    def handle_submit_task(self, conn, payload: bytes):
+        from ray_tpu.remote_function import RemoteFunction
+
+        fn, args, kwargs, opts = self._loads(conn, payload)
+        out = RemoteFunction(fn, opts).remote(*args, **kwargs)
+        refs = out if isinstance(out, (list, tuple)) else [out]
+        return self._register(conn, list(refs))
+
+    def handle_create_actor(self, conn, payload: bytes):
+        from ray_tpu.actor import ActorClass
+
+        cls, args, kwargs, opts = self._loads(conn, payload)
+        handle = ActorClass(cls, opts).remote(*args, **kwargs)
+        aid = handle._actor_id
+        self._actors.setdefault(conn, {})[aid.binary()] = handle
+        return aid.binary()
+
+    def handle_submit_actor_task(self, conn, actor_id: bytes,
+                                 method_name: str, payload: bytes):
+        handle = self._actors.get(conn, {}).get(actor_id)
+        if handle is None:
+            raise ValueError("unknown actor for this client session")
+        args, kwargs, opts = self._loads(conn, payload)
+        method = getattr(handle, method_name)
+        if opts is not None and opts.num_returns != 1:
+            method = method.options(num_returns=opts.num_returns)
+        out = method.remote(*args, **kwargs)
+        refs = out if isinstance(out, (list, tuple)) else [out]
+        return self._register(conn, list(refs))
+
+    def handle_get_named_actor(self, conn, name: str, namespace=None):
+        handle = self._ray.get_actor(name)
+        aid = handle._actor_id
+        # setdefault: if this session already holds the OWNED handle for the
+        # actor, replacing it would GC it → out-of-scope kill of a live actor
+        self._actors.setdefault(conn, {}).setdefault(aid.binary(), handle)
+        return aid.binary()
+
+    def handle_kill_actor(self, conn, actor_id: bytes, no_restart=True):
+        handle = self._actors.get(conn, {}).pop(actor_id, None)
+        if handle is not None:
+            self._ray.kill(handle, no_restart=no_restart)
+        return True
+
+    def handle_release(self, conn, oid_hexes: list):
+        reg = self._registry(conn)
+        for h in oid_hexes:
+            reg.pop(h, None)
+        return True
+
+    def handle_cluster_resources(self, conn):
+        return self._ray.cluster_resources()
+
+    def handle_available_resources(self, conn):
+        return self._ray.available_resources()
+
+    def handle_nodes(self, conn):
+        return self._ray.nodes()

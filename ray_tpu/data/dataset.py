@@ -166,7 +166,16 @@ class Dataset:
             yield from self._materialized
             return
         executor = StreamingExecutor(**executor_kwargs)
+        self._last_stats = executor.stats
         yield from executor.execute(self._ops)
+
+    def stats(self) -> str:
+        """Per-operator execution stats of the most recent run (parity:
+        Dataset.stats() over _internal/stats.py instrumentation)."""
+        stats = getattr(self, "_last_stats", None)
+        if stats is None or not stats.ops:
+            return "Dataset has not been executed yet (no stats)."
+        return stats.summary()
 
     def materialize(self) -> "Dataset":
         """Execute the plan now; the result holds block refs (reference:

@@ -98,11 +98,47 @@ class _MapActor:
         return _apply_fn(self._fn, block, self._args, self._kwargs)
 
 
+class OpStats:
+    """Per-operator execution counters (parity: data/_internal/stats.py)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.blocks = 0
+        self.wall_s = 0.0
+
+
+class DatasetStats:
+    def __init__(self):
+        self.ops: List[OpStats] = []
+
+    def add_op(self, name: str) -> OpStats:
+        op = OpStats(name)
+        self.ops.append(op)
+        return op
+
+    def summary(self) -> str:
+        """Per-op SELF time: each _timed layer's gross time includes its
+        whole upstream chain (pull-based pipeline), so op i's own cost is
+        gross[i] - gross[i-1]."""
+        lines = ["Dataset execution stats:"]
+        prev = 0.0
+        for op in self.ops:
+            self_s = max(op.wall_s - prev, 0.0)
+            prev = op.wall_s
+            rate = op.blocks / self_s if self_s > 0 else float("inf")
+            lines.append(
+                f"  {op.name:<14s} blocks={op.blocks:<6d} "
+                f"wall={self_s * 1000:8.1f}ms  ({rate:,.1f} blocks/s)"
+            )
+        return "\n".join(lines)
+
+
 class StreamingExecutor:
     def __init__(self, max_tasks_in_flight: int = 8, preserve_order: bool = True):
         self.max_in_flight = max_tasks_in_flight
         self.preserve_order = preserve_order
         self._actor_pools: List[List[Any]] = []
+        self.stats = DatasetStats()
 
     # -------------------------------------------------------------- execute
     def execute(self, ops: Sequence[Op]) -> Iterator[Any]:
@@ -126,9 +162,33 @@ class StreamingExecutor:
                     stream = self._rechunk_stream(op, stream)
                 else:
                     raise TypeError(f"unknown operator {op!r}")
+                stream = self._timed(
+                    getattr(op, "name", type(op).__name__), stream
+                )
             yield from stream
         finally:
             self._shutdown_pools()
+
+    def _timed(self, name: str, stream: Iterator[Any]) -> Iterator[Any]:
+        """Wrap a stage: time spent pulling from it + block count feed the
+        per-op stats (Dataset.stats())."""
+        import time as _time
+
+        entry = self.stats.add_op(name)
+
+        def gen():
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    ref = next(stream)
+                except StopIteration:
+                    return
+                finally:
+                    entry.wall_s += _time.perf_counter() - t0
+                entry.blocks += 1
+                yield ref
+
+        return gen()
 
     # -------------------------------------------------------------- stages
     def _bounded(self, submit_iter: Iterator[Any]) -> Iterator[Any]:
