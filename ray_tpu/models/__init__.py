@@ -1,0 +1,2 @@
+
+from ray_tpu.models import gpt2, llama  # noqa: F401,E402
