@@ -9,8 +9,10 @@ from ray_tpu.data.dataset import (
     Dataset,
     from_items,
     from_numpy,
+    from_pandas,
     range,
     read_csv,
+    read_json,
     read_parquet,
 )
 from ray_tpu.data.executor import ActorPoolStrategy
@@ -20,7 +22,9 @@ __all__ = [
     "Dataset",
     "from_items",
     "from_numpy",
+    "from_pandas",
     "range",
     "read_csv",
+    "read_json",
     "read_parquet",
 ]

@@ -230,6 +230,30 @@ class Dataset:
             block_num_rows(ray_tpu.get(r)) for r in self.iter_block_refs()
         )
 
+    def write_parquet(self, path: str) -> List[str]:
+        """One parquet file per block via remote writer tasks (parity:
+        Dataset.write_parquet); returns the written file paths."""
+        import os
+
+        import ray_tpu
+
+        os.makedirs(path, exist_ok=True)
+
+        def write_block(block: Block, out_path: str) -> str:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            table = pa.table({k: np.asarray(v) for k, v in block.items()})
+            pq.write_table(table, out_path)
+            return out_path
+
+        writer = ray_tpu.remote(num_cpus=0.25)(write_block)
+        refs = [
+            writer.remote(r, os.path.join(path, f"part-{i:05d}.parquet"))
+            for i, r in enumerate(self.iter_block_refs())
+        ]
+        return ray_tpu.get(refs, timeout=600)
+
     def schema(self) -> Optional[Dict[str, str]]:
         import ray_tpu
 
@@ -406,3 +430,20 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
 
 def read_csv(paths) -> Dataset:
     return Dataset([ReadOp(ds_mod.CSVDatasource(paths).read_tasks())])
+
+
+def read_json(paths) -> Dataset:
+    """JSON-lines files (parity: ray.data.read_json)."""
+    return Dataset([ReadOp(ds_mod.JSONDatasource(paths).read_tasks())])
+
+
+def from_pandas(dfs) -> Dataset:
+    """One block per DataFrame (parity: ray.data.from_pandas)."""
+    if not isinstance(dfs, (list, tuple)):
+        dfs = [dfs]
+    blocks = [
+        {c: np.asarray(df[c]) for c in df.columns} for df in dfs
+    ]
+    import ray_tpu
+
+    return Dataset([], materialized_refs=[ray_tpu.put(b) for b in blocks])

@@ -143,3 +143,29 @@ class NumpyDatasource:
             return task
 
         return [make(a) for a in self.arrays]
+
+@dataclass
+class JSONDatasource:
+    """JSON-lines files: one object per line → one block per file."""
+
+    paths: Any
+
+    def read_tasks(self) -> List[ReadTask]:
+        files = _expand_paths(self.paths)
+
+        def make(path):
+            def task() -> Block:
+                import json
+
+                from ray_tpu.data.block import block_from_rows
+
+                rows = []
+                with open(path) as f:
+                    for line in f:
+                        if line.strip():
+                            rows.append(json.loads(line))
+                return block_from_rows(rows)
+
+            return task
+
+        return [make(p) for p in files]

@@ -1,5 +1,9 @@
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
-__all__ = ["DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig"]
+__all__ = [
+    "BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
+    "PPO", "PPOConfig",
+]

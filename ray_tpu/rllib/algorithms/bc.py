@@ -1,0 +1,141 @@
+"""Behavior Cloning: supervised policy learning from logged experience.
+
+Parity: rllib/algorithms/bc/ (+ rllib/offline/ as the input path) — the
+simplest offline algorithm: maximize log-likelihood of the dataset's
+actions under the policy. The update is one jitted cross-entropy step on
+device; evaluation rolls the learned policy in the real env between
+training iterations so episode_reward_mean is comparable to the online
+algorithms' reports.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class BCLearner:
+    def __init__(self, obs_dim, num_actions, hiddens=(64, 64), lr=1e-3,
+                 grad_clip=10.0, seed=0, **_unused):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import (
+            categorical_logp,
+            mlp_actor_critic_apply,
+            mlp_actor_critic_init,
+        )
+
+        params = mlp_actor_critic_init(
+            jax.random.PRNGKey(seed), obs_dim, num_actions, tuple(hiddens)
+        )
+        self._opt = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self._state = {"params": params, "opt_state": self._opt.init(params)}
+
+        def update(state, obs, actions):
+            def loss_fn(params):
+                logits, _ = mlp_actor_critic_apply(params, obs)
+                return -jnp.mean(categorical_logp(logits, actions))
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, new_opt = self._opt.update(
+                grads, state["opt_state"], state["params"]
+            )
+            new_params = optax.apply_updates(state["params"], updates)
+            return {"params": new_params, "opt_state": new_opt}, loss
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        self._state, loss = self._update(
+            self._state,
+            jnp.asarray(batch[SampleBatch.OBS], jnp.float32),
+            jnp.asarray(batch[SampleBatch.ACTIONS], jnp.int32),
+        )
+        return {"loss": float(loss)}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self._state["params"])
+
+    def set_weights(self, params) -> None:
+        self._state["params"] = params
+
+    def get_state(self):
+        import jax
+
+        return {"state": jax.device_get(self._state)}
+
+    def set_state(self, state) -> None:
+        self._state = state["state"]
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.input_path: str = ""
+        self.train_batch_size = 256
+        self.train_intensity = 32      # learner updates per training_step
+        self.lr = 1e-3
+
+    def offline_data(self, input_path: str) -> "BCConfig":
+        self.input_path = input_path
+        return self
+
+
+class BC(Algorithm):
+    config_class = BCConfig
+
+    def _make_learner_group(self) -> LearnerGroup:
+        cfg = self.algo_config
+        if not cfg.input_path:
+            raise ValueError("BCConfig.offline_data(input_path=...) required")
+        from ray_tpu.rllib.offline import JsonReader
+
+        data = JsonReader(cfg.input_path).read_all()
+        self.buffer = ReplayBuffer(capacity=max(len(data), 1), seed=cfg.seed)
+        self.buffer.add(data)
+        return LearnerGroup(
+            BCLearner,
+            dict(
+                obs_dim=self.obs_dim,
+                num_actions=self.num_actions,
+                hiddens=tuple(cfg.hiddens),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                seed=cfg.seed,
+            ),
+            mode=cfg.learner_mode,
+            remote_options=cfg.learner_remote_options,
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.train_intensity):
+            mb = self.buffer.sample(cfg.train_batch_size)
+            metrics = self.learner_group.update(mb)
+        self._weights = self.learner_group.get_weights()
+
+        # evaluation rollout with the cloned policy (categorical acting)
+        if self.local_runner is not None:
+            _, ep = self.local_runner.sample(
+                cfg.rollout_fragment_length, self._weights
+            )
+            self._merge_episode_metrics(ep)
+        stats = self._episode_stats()
+        stats.update(metrics)
+        stats["dataset_size"] = len(self.buffer)
+        return stats

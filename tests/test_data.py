@@ -8,6 +8,8 @@ streaming executor tests — SURVEY.md §4.5).
 import numpy as np
 import pytest
 
+builtins_range = range  # rd.range shadows the builtin in this module's style
+
 from ray_tpu import data as rd
 from ray_tpu.data.block import (
     block_concat,
@@ -337,3 +339,29 @@ def test_dataset_stats(ray_start_local):
     s = ds.stats()
     assert "Read" in s and "MapBatches" in s
     assert "blocks=4" in s
+
+
+def test_read_json_from_pandas_write_parquet(ray_start_local, tmp_path):
+    pd = pytest.importorskip("pandas")
+    pytest.importorskip("pyarrow")
+    import json as _json
+
+    # read_json (jsonl)
+    p = tmp_path / "rows.jsonl"
+    p.write_text("\n".join(_json.dumps({"a": i, "b": f"s{i}"})
+                           for i in builtins_range(6)))
+    ds = rd.read_json(str(p))
+    assert ds.count() == 6
+    assert sorted(r["a"] for r in ds.take_all()) == list(builtins_range(6))
+
+    # from_pandas
+    df = pd.DataFrame({"x": [1, 2, 3], "y": [1.0, 2.0, 3.0]})
+    ds2 = rd.from_pandas(df)
+    assert ds2.count() == 3 and ds2.take(1)[0]["y"] == 1.0
+
+    # write_parquet roundtrip
+    outdir = tmp_path / "out"
+    files = rd.range(40, parallelism=3).write_parquet(str(outdir))
+    assert len(files) == 3
+    back = rd.read_parquet(str(outdir))
+    assert sorted(r["id"] for r in back.take_all()) == list(builtins_range(40))
