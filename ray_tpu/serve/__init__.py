@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.deployment import AutoscalingConfig, Deployment
 
 __all__ = [
@@ -23,6 +24,8 @@ __all__ = [
     "Deployment",
     "batch",
     "delete",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "deployment",
     "get_handle",
     "http_address",

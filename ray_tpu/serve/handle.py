@@ -52,8 +52,12 @@ class Router:
         return self._routes.get(path)
 
     def assign_request(self, deployment: str, *args, **kwargs):
+        return self.assign_request_with_replica(deployment, *args, **kwargs)[0]
+
+    def assign_request_with_replica(self, deployment: str, *args, **kwargs):
         """Pick a replica (power of two choices on local in-flight counts)
-        and dispatch; returns the ObjectRef."""
+        and dispatch; returns (ObjectRef, replica handle) — streaming keeps
+        pulling chunks from the SAME replica."""
         self._refresh()
         deadline = time.monotonic() + 30
         while True:
@@ -77,7 +81,7 @@ class Router:
             counts[idx] = counts.get(idx, 0) + 1
         ref = replicas[idx].handle_request.remote(*args, **kwargs)
         self._track_completion(deployment, idx, ref)
-        return ref
+        return ref, replicas[idx]
 
     def _track_completion(self, deployment: str, idx: int, ref) -> None:
         import ray_tpu
@@ -104,3 +108,23 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         return self._router.assign_request(self.deployment_name, *args, **kwargs)
+
+    def stream(self, *args, **kwargs):
+        """Iterate a streaming deployment's chunks as they are produced
+        (parity: the reference's streaming handles / replica.py:231). A
+        non-generator response yields once."""
+        import ray_tpu
+
+        ref, replica = self._router.assign_request_with_replica(
+            self.deployment_name, *args, **kwargs
+        )
+        first = ray_tpu.get(ref, timeout=60)
+        if not (isinstance(first, dict) and "__serve_stream__" in first):
+            yield first
+            return
+        sid = first["__serve_stream__"]
+        while True:
+            chunk = ray_tpu.get(replica.next_chunk.remote(sid), timeout=60)
+            if chunk.get("done"):
+                return
+            yield chunk["value"]

@@ -65,6 +65,31 @@ class HTTPProxy:
             status, payload = await asyncio.get_running_loop().run_in_executor(
                 None, self._dispatch, method, path, body
             )
+            if status == "stream":
+                # chunked transfer: one JSON line per generator item, flushed
+                # as produced (parity: streaming responses, replica.py:231)
+                replica, sid = payload
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/jsonl\r\n"
+                    b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+                )
+                await writer.drain()
+                loop = asyncio.get_running_loop()
+                while True:
+                    chunk = await loop.run_in_executor(
+                        None, self._next_chunk, replica, sid
+                    )
+                    if chunk is None:
+                        break
+                    data = (json.dumps(chunk, default=str) + "\n").encode()
+                    writer.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                    )
+                    await writer.drain()
+                writer.write(b"0\r\n\r\n")
+                await writer.drain()
+                return
             data = json.dumps(payload, default=str).encode()
             writer.write(
                 f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
@@ -95,8 +120,18 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 args = (body.decode("utf-8", "replace"),)
         try:
-            ref = self._router.assign_request(name, *args)
+            ref, replica = self._router.assign_request_with_replica(name, *args)
             result = ray_tpu.get(ref, timeout=60)
+            if isinstance(result, dict) and "__serve_stream__" in result:
+                return "stream", (replica, result["__serve_stream__"])
             return "200 OK", {"result": result}
         except Exception as e:  # noqa: BLE001 - surface as 500
             return "500 Internal Server Error", {"error": str(e)}
+
+    def _next_chunk(self, replica, sid):
+        import ray_tpu
+
+        chunk = ray_tpu.get(replica.next_chunk.remote(sid), timeout=60)
+        if chunk.get("done"):
+            return None
+        return chunk["value"]

@@ -1,0 +1,102 @@
+"""Model multiplexing: many models per replica behind an LRU.
+
+Parity: python/ray/serve/multiplex.py (`@serve.multiplexed` +
+`serve.get_multiplexed_model_id`) — one deployment serves N models, each
+replica lazily loading the ones it sees and evicting least-recently-used
+beyond the cap. The TPU shape of this: model weights are big, replicas are
+few, so the loader runs once per (replica, model) and eviction calls the
+model's `__del__`/`unload` to release HBM.
+
+    @serve.deployment
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return load_weights(model_id)          # expensive, cached
+
+        def __call__(self, req):
+            model = self.get_model(req["model"])
+            return model.predict(req["x"])
+
+Requests carry the model id explicitly (our proxy does not parse routing
+headers); inside a loader, `get_multiplexed_model_id()` returns the id
+being loaded.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_ctx = threading.local()
+
+
+def get_multiplexed_model_id() -> Optional[str]:
+    """The model id currently being loaded/served on this thread."""
+    return getattr(_ctx, "model_id", None)
+
+
+class _MultiplexWrapper:
+    """Descriptor: per-instance LRU of loaded models (thread-safe — replicas
+    execute concurrent requests on a thread pool)."""
+
+    def __init__(self, fn: Callable, max_models: int):
+        self._fn = fn
+        self._max = max_models
+        functools.update_wrapper(self, fn)
+
+    def __reduce__(self):
+        # deployments ship their class through cloudpickle; caches/locks
+        # must rebuild fresh on the replica
+        return (_MultiplexWrapper, (self._fn, self._max))
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        state = obj.__dict__.setdefault("__serve_multiplex__", {})
+        entry = state.get(id(self))
+        if entry is None:
+            entry = state[id(self)] = {
+                "lru": OrderedDict(), "lock": threading.Lock(),
+            }
+
+        def bound(model_id: str):
+            with entry["lock"]:
+                if model_id in entry["lru"]:
+                    entry["lru"].move_to_end(model_id)
+                    return entry["lru"][model_id]
+            _ctx.model_id = model_id
+            try:
+                model = self._fn(obj, model_id)
+            finally:
+                _ctx.model_id = None
+            with entry["lock"]:
+                entry["lru"][model_id] = model
+                entry["lru"].move_to_end(model_id)
+                while len(entry["lru"]) > self._max:
+                    _, evicted = entry["lru"].popitem(last=False)
+                    unload = getattr(evicted, "unload", None)
+                    if callable(unload):
+                        try:
+                            unload()
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
+            return model
+
+        functools.update_wrapper(bound, self._fn)
+        bound._multiplex_lru = entry["lru"]  # introspection/testing hook
+        return bound
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator (with or without arguments), reference-API compatible."""
+    if max_num_models_per_replica < 1:
+        raise ValueError("max_num_models_per_replica must be >= 1")
+
+    def deco(fn):
+        return _MultiplexWrapper(fn, max_num_models_per_replica)
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

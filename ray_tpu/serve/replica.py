@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import asyncio
 import inspect
-from typing import Any
+import uuid
+from typing import Any, Dict
 
 
 class ServeReplica:
@@ -21,6 +22,7 @@ class ServeReplica:
             self._callable = func_or_class
         self._ongoing = 0
         self._total = 0
+        self._streams: Dict[str, Any] = {}
 
     def handle_request(self, *args, **kwargs) -> Any:
         self._ongoing += 1
@@ -32,9 +34,29 @@ class ServeReplica:
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = asyncio.run(result)
+            if inspect.isgenerator(result):
+                # streaming response (parity: replica.py:231 generator
+                # handling): chunks are pulled with next_chunk; the marker
+                # routes handles/proxy onto the streaming path
+                sid = uuid.uuid4().hex
+                self._streams[sid] = result
+                return {"__serve_stream__": sid}
             return result
         finally:
             self._ongoing -= 1
+
+    def next_chunk(self, sid: str) -> Dict[str, Any]:
+        gen = self._streams.get(sid)
+        if gen is None:
+            return {"done": True}
+        try:
+            return {"done": False, "value": next(gen)}
+        except StopIteration:
+            self._streams.pop(sid, None)
+            return {"done": True}
+        except Exception:
+            self._streams.pop(sid, None)
+            raise
 
     def num_ongoing_requests(self) -> int:
         return self._ongoing
