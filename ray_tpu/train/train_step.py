@@ -135,6 +135,74 @@ def make_gpt2_train_step(
     )
 
 
+def make_llama_train_step(
+    cfg,
+    mesh: Optional[Mesh] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    rng: Optional[jax.Array] = None,
+    rules: Optional[Dict] = None,
+) -> TrainStepBundle:
+    """Sharded train step for the LLaMA family (models/llama.py) — same
+    factory shape as make_gpt2_train_step: born-sharded init, jitted
+    fwd+bwd+AdamW with donated buffers, data split over the batch axes."""
+    from ray_tpu.models import llama
+
+    if mesh is None:
+        mesh = mesh_lib.single_device_mesh()
+    if optimizer is None:
+        optimizer = default_optimizer()
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    log_axes = llama.logical_axes(cfg)
+    param_shardings = sharding_lib.tree_shardings(mesh, log_axes, rules)
+    params = jax.jit(
+        lambda r: llama.init(cfg, r), out_shardings=param_shardings
+    )(rng)
+    opt_shardings = _opt_state_shardings(optimizer, params, param_shardings, mesh)
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
+    state = {
+        "params": params,
+        "opt_state": opt_state,
+        "step": jnp.zeros((), jnp.int32),
+    }
+    data_sh = mesh_lib.data_sharding(mesh, extra_dims=1)
+
+    def step(state, batch):
+        tokens, targets = batch["tokens"], batch["targets"]
+        with mesh_lib.use_mesh(mesh):
+            loss, grads = jax.value_and_grad(llama.loss_fn)(
+                state["params"], tokens, targets, cfg
+            )
+        updates, new_opt = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        new_params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": new_params,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss,
+                           "grad_norm": optax.global_norm(grads)}
+
+    state_shardings = {
+        "params": param_shardings,
+        "opt_state": opt_shardings,
+        "step": NamedSharding(mesh, P()),
+    }
+    step_fn = jax.jit(
+        step,
+        in_shardings=(state_shardings,
+                      {"tokens": data_sh, "targets": data_sh}),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+    return TrainStepBundle(
+        state=state, step_fn=step_fn, mesh=mesh, data_sharding=data_sh, cfg=cfg
+    )
+
+
 def _opt_state_shardings(optimizer, params, param_shardings, mesh):
     """Derive shardings for the optimizer state: any leaf whose shape matches a
     param mirrors that param's sharding; everything else replicates."""
