@@ -110,9 +110,17 @@ class Raylet:
     # ------------------------------------------------------------ lifecycle
     async def start(self):
         await self.server.start()
+        worker_env = dict(self.worker_env)
+        if not self.total.get("TPU"):
+            # TPU-less node: pin workers to the CPU backend EXPLICITLY.
+            # Merely unsetting JAX_PLATFORMS restores the sitecustomize
+            # default (axon,cpu), so every worker tried to initialize the
+            # TPU plugin at boot — seconds of import plus libtpu-lockfile
+            # contention across the whole worker fleet.
+            worker_env.setdefault("JAX_PLATFORMS", "cpu")
         self.pool = WorkerPool(
             self.server.address, self.gcs_address, self.session, self.node_id,
-            env=self.worker_env,
+            env=worker_env,
         )
         self.pool.on_worker_death = self._on_worker_death
         # native data plane: sendfile daemon serving this node's shm dir
