@@ -71,8 +71,12 @@ class FakeTpuApiTransport:
         self.calls.append((method, path, body))
         if method == "POST" and "/queuedResources" in path:
             qr_id = path.rsplit("queued_resource_id=", 1)[-1]
+            parent = path.split("/queuedResources", 1)[0]
             self.resources[qr_id] = {
-                "name": qr_id, "state": "WAITING", "ticks": 0,
+                # fully-qualified, like the real API (the provider must
+                # normalize back to the trailing id for terminate/state)
+                "name": f"{parent}/queuedResources/{qr_id}",
+                "state": "WAITING", "ticks": 0,
                 "spec": body,
             }
             return {"name": f"operations/{qr_id}"}
@@ -163,7 +167,11 @@ class TpuPodProvider(NodeProvider):
         for r in reply.get("queuedResources", []):
             state = (r.get("state") or {}).get("state", "")
             if state in ACTIVE_STATES:
-                out.append(r["name"])
+                # the real API returns fully-qualified names
+                # (projects/.../queuedResources/<id>); node ids are re-embedded
+                # after {parent}/queuedResources/ in terminate/state paths, so
+                # normalize to the trailing id
+                out.append(r["name"].rsplit("/", 1)[-1])
         return out
 
     # --------------------------------------------------------------- extras

@@ -100,7 +100,7 @@ class _LeasePool:
 
     def __init__(self):
         self.idle: List[_LeaseEntry] = []
-        self.total = 0
+        self.pending = 0  # unresolved lease REQUESTS only (rate-limit gate)
         self.error: Optional[BaseException] = None  # latest failed request
         from collections import deque
 
@@ -814,13 +814,16 @@ class CoreWorker:
                 if entry.conn is not None and not entry.conn.closed:
                     return entry
                 await self._drop_lease(pool, entry)
-            if pool.total >= _config.max_pending_lease_requests_per_scheduling_key:
+            # Rate-limit UNRESOLVED requests only (matching the reference's
+            # lease-request limiter): granted leases are unbounded, so
+            # long-running same-shape tasks keep full cluster parallelism.
+            if pool.pending >= _config.max_pending_lease_requests_per_scheduling_key:
                 await pool.wait(timeout=0.5)
                 continue
             # race a fresh lease request against a cached entry freeing up;
             # the loser is cleaned up (queued request → cancel RPC; grant
             # that slips through anyway → pooled for the next waiter)
-            pool.total += 1
+            pool.pending += 1
             req_id = _uuid.uuid4().hex
             holder: Dict[str, Any] = {}
             req = asyncio.ensure_future(
@@ -832,16 +835,16 @@ class CoreWorker:
                 {req, waiter}, return_when=asyncio.FIRST_COMPLETED
             )
             if req.done():
+                pool.pending -= 1
+                pool.wake()  # a pending slot freed: let a gated waiter retry
                 if not waiter.done():
                     waiter.cancel()
                 try:
                     entry = req.result()
                 except BaseException:
-                    pool.total -= 1
                     pool.wake()
                     raise
                 if entry is None:  # canceled under us (shouldn't happen here)
-                    pool.total -= 1
                     continue
                 pool.idle.append(entry)
                 pool.wake()
@@ -864,11 +867,11 @@ class CoreWorker:
         try:
             entry = await req
         except BaseException:  # noqa: BLE001 - request failed: slot freed
-            pool.total -= 1
+            pool.pending -= 1
             pool.wake()
             return
+        pool.pending -= 1
         if entry is None:      # canceled cleanly
-            pool.total -= 1
             pool.wake()
             return
         pool.idle.append(entry)
@@ -876,7 +879,6 @@ class CoreWorker:
 
 
     async def _drop_lease(self, pool, entry: "_LeaseEntry"):
-        pool.total -= 1
         pool.wake()
         try:
             await entry.raylet.call(

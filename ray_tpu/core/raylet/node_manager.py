@@ -786,8 +786,24 @@ class Raylet:
         """An owner's connection dropped: reclaim every lease it still
         holds and drop its queued lease requests (parity: the reference
         raylet cancels leases on owner death)."""
-        for lease_id in list(self._lease_owners.pop(conn, ())):
+        owned = list(self._lease_owners.pop(conn, ()))
+        if owned:
+            logger.info("owner %s disconnected with %d leases", conn, len(owned))
+        for lease_id in owned:
+            entry = self.active_leases.get(lease_id)
+            worker = entry[1] if entry is not None else None
+            was_leased = worker is not None and worker.state == LEASED
             self.handle_return_lease(None, lease_id)
+            # The owner pushes tasks to the worker over a DIRECT connection
+            # the raylet can't observe, so a LEASED worker may still be
+            # mid-task for the dead owner. Recycling it to IDLE would let
+            # the scheduler push a second concurrent task onto a busy
+            # worker — kill it instead and let demand respawn a fresh one
+            # (reference: raylet destroys leased workers on owner death).
+            if was_leased and worker.actor_id is None:
+                logger.info("killing mid-task worker token=%s pid=%s of dead owner",
+                            worker.startup_token, worker.proc.pid)
+                self.pool.kill_worker(worker)
         for lr in list(self.pending_leases):
             if lr.owner_conn is conn:
                 self.pending_leases.remove(lr)

@@ -111,7 +111,10 @@ class WorkerPool:
     async def poll_deaths(self):
         """Detect worker process exits (reference: raylet socket monitoring)."""
         for w in list(self.workers.values()):
-            if w.state != DEAD and w.proc.poll() is not None:
+            # poll() unconditionally: it also reaps zombies of workers we
+            # killed ourselves (kill_worker marks DEAD before the process
+            # is waited on)
+            if w.proc.poll() is not None and w.state != DEAD:
                 w.state = DEAD
                 logger.warning(
                     "worker pid=%d token=%d died (exit %s)",

@@ -375,6 +375,8 @@ class GroupedDataset:
         parts = hash_partition(refs, key, num_partitions or max(len(refs), 1))
 
         def apply_groups(block: Block) -> Block:
+            if key not in block or block_num_rows(block) == 0:
+                return block  # empty hash partition: no groups landed here
             ks = block[key]
             keys = [k.item() if hasattr(k, "item") else k for k in ks]
             order: Dict[Any, list] = {}

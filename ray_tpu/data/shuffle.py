@@ -22,6 +22,7 @@ dataset on the driver).
 from __future__ import annotations
 
 import builtins
+import zlib
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -170,9 +171,18 @@ def hash_partition(refs: List[Any], key: str,
         arr = np.asarray(col)
         if arr.dtype.kind in "iub":
             return (arr.astype(np.int64) % R + R) % R
-        # strings/objects: stable python hash via a vectorized fallback
-        return np.asarray(
-            [builtins.hash(x) % R for x in arr.tolist()], dtype=np.int64
-        )
+        # strings/objects: process-independent hash. builtins.hash is salted
+        # per interpreter (PYTHONHASHSEED), and map tasks run in separate
+        # worker processes — the same key MUST route to the same partition
+        # from every map task, so use crc32 over the repr bytes instead.
+        # Integers that arrive via an object-dtype block (e.g. a mixed-type
+        # column) must agree with the int64 fast path above, so they keep
+        # the value % R rule.
+        def one(x):
+            if isinstance(x, (int, np.integer)):  # incl. bool: matches "b" path
+                return int(x) % R
+            return zlib.crc32(repr(x).encode("utf-8", "surrogatepass")) % R
+
+        return np.asarray([one(x) for x in arr.tolist()], dtype=np.int64)
 
     return shuffle_blocks(refs, partitioner, num_partitions)

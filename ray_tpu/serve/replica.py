@@ -10,8 +10,16 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import time
 import uuid
-from typing import Any, Dict
+from collections import deque
+from typing import Any, Dict, Tuple
+
+# A client that abandons a stream (proxy disconnect, dropped iterator) never
+# drains it to StopIteration, so undrained generators must be reaped or they
+# accumulate in the replica forever.
+STREAM_IDLE_TIMEOUT_S = 300.0
+MAX_STREAMS = 1024
 
 
 class ServeReplica:
@@ -22,7 +30,35 @@ class ServeReplica:
             self._callable = func_or_class
         self._ongoing = 0
         self._total = 0
-        self._streams: Dict[str, Any] = {}
+        self._streams: Dict[str, Tuple[Any, float]] = {}  # sid -> (gen, last_access)
+        # sids reaped while undrained: a later next_chunk must raise, not
+        # report a clean end-of-stream (silent truncation). Bounded FIFO.
+        self._reaped: "deque[str]" = deque(maxlen=4096)
+        self._reaped_set: set = set()
+
+    def _reap_streams(self) -> None:
+        now = time.monotonic()
+        dead = {sid for sid, (_, ts) in self._streams.items()
+                if now - ts > STREAM_IDLE_TIMEOUT_S}
+        live = len(self._streams) - len(dead)
+        if live >= MAX_STREAMS:
+            # still at cap: evict least-recently-accessed live streams
+            by_age = sorted(
+                (s for s in self._streams if s not in dead),
+                key=lambda s: self._streams[s][1],
+            )
+            dead.update(by_age[: live - MAX_STREAMS + 1])
+        for sid in dead:
+            gen, _ = self._streams.pop(sid, (None, 0.0))
+            if gen is not None:
+                try:
+                    gen.close()
+                except Exception:
+                    pass
+            if len(self._reaped) == self._reaped.maxlen:
+                self._reaped_set.discard(self._reaped[0])
+            self._reaped.append(sid)
+            self._reaped_set.add(sid)
 
     def handle_request(self, *args, **kwargs) -> Any:
         self._ongoing += 1
@@ -38,25 +74,35 @@ class ServeReplica:
                 # streaming response (parity: replica.py:231 generator
                 # handling): chunks are pulled with next_chunk; the marker
                 # routes handles/proxy onto the streaming path
+                self._reap_streams()
                 sid = uuid.uuid4().hex
-                self._streams[sid] = result
+                self._streams[sid] = (result, time.monotonic())
                 return {"__serve_stream__": sid}
             return result
         finally:
             self._ongoing -= 1
 
     def next_chunk(self, sid: str) -> Dict[str, Any]:
-        gen = self._streams.get(sid)
-        if gen is None:
+        entry = self._streams.get(sid)
+        if entry is None:
+            if sid in self._reaped_set:
+                raise RuntimeError(
+                    f"stream {sid} was reaped (idle > "
+                    f"{STREAM_IDLE_TIMEOUT_S}s or replica over "
+                    f"{MAX_STREAMS} streams); response is incomplete"
+                )
             return {"done": True}
+        gen, _ = entry
         try:
-            return {"done": False, "value": next(gen)}
+            value = next(gen)
         except StopIteration:
             self._streams.pop(sid, None)
             return {"done": True}
         except Exception:
             self._streams.pop(sid, None)
             raise
+        self._streams[sid] = (gen, time.monotonic())
+        return {"done": False, "value": value}
 
     def num_ongoing_requests(self) -> int:
         return self._ongoing

@@ -190,3 +190,37 @@ def test_actor_call_ordering_pipelined(ray_cluster):
     refs = [log.add.remote(i) for i in range(200)]
     assert ray.get(refs, timeout=120) == list(range(200))
     assert ray.get(log.seen_list.remote(), timeout=60) == list(range(200))
+
+
+def test_granted_leases_not_capped_by_pending_limit(ray_cluster, tmp_path):
+    """The lease-request rate limiter must cap UNRESOLVED requests only
+    (reference: direct_task_transport.h:56-72 lease rate limiter). If
+    granted leases counted against the cap, cap=1 would allow exactly one
+    concurrently-running task per scheduling key and this barrier would
+    never clear (ADVICE r4: core_worker.py lease-pool accounting)."""
+    import os
+
+    ray = ray_cluster
+    from ray_tpu.core.config import _config
+
+    old = _config.max_pending_lease_requests_per_scheduling_key
+    _config.max_pending_lease_requests_per_scheduling_key = 1
+    try:
+        @ray.remote(num_cpus=0)
+        def hold(dir_, n):
+            import os as _os
+            import time as _time
+
+            open(_os.path.join(dir_, f"p{_os.getpid()}"), "w").close()
+            deadline = _time.time() + 60
+            while len(_os.listdir(dir_)) < n:
+                if _time.time() > deadline:
+                    return False
+                _time.sleep(0.05)
+            return True
+
+        d = str(tmp_path)
+        refs = [hold.remote(d, 3) for _ in range(3)]
+        assert ray.get(refs, timeout=120) == [True, True, True]
+    finally:
+        _config.max_pending_lease_requests_per_scheduling_key = old

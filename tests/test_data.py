@@ -291,6 +291,31 @@ def test_groupby_map_groups_shuffled(ray_start_regular):
     assert rows == {k: 95.0 for k in range(5)}
 
 
+def test_groupby_string_keys_cross_process(ray_start_regular):
+    """String keys must route to the SAME partition from every map task.
+
+    Map tasks run in separate worker processes whose builtins.hash salts
+    differ (PYTHONHASHSEED is unset) — a per-process hash would scatter one
+    key across partitions and map_groups would emit duplicated groups.
+    The partitioner therefore uses a process-independent hash (crc32)."""
+    keys = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    items = [{"k": keys[i % 5], "v": float(i)} for i in range(200)]
+    # many blocks => many distinct map worker processes
+    ds = rd.from_items(items, parallelism=8)
+
+    def count(group):
+        return {"k": group["k"][:1],
+                "n": np.asarray([len(np.asarray(group["v"]))])}
+
+    out = ds.groupby("k").map_groups(count, num_partitions=4)
+    rows = [(str(r["k"]), int(r["n"])) for r in out.take_all()]
+    seen = {}
+    for k, n in rows:
+        assert k not in seen, f"key {k!r} split across partitions: {rows}"
+        seen[k] = n
+    assert seen == {k: 40 for k in keys}
+
+
 def test_preprocessors(ray_start_local):
     """fit/transform layer (parity: ray/data/preprocessors/)."""
     from ray_tpu.data.preprocessors import (

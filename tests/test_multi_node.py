@@ -168,3 +168,72 @@ def test_workers_exit_when_raylet_killed():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_owner_death_kills_mid_task_worker(tmp_path):
+    """When a driver dies, a worker still EXECUTING its task must be killed,
+    not recycled to IDLE: the raylet cannot observe the direct owner->worker
+    push, so recycling would hand a busy worker to the next owner (ADVICE
+    r4: node_manager.on_disconnection). The freed resources must also let a
+    new driver's task run."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=cluster.address)
+    pidfile = str(tmp_path / "worker_pid")
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import sys\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=sys.argv[1])\n"
+        "@ray_tpu.remote(num_cpus=1)\n"
+        "def long_task(pidfile):\n"
+        "    import os, time\n"
+        "    with open(pidfile + '.tmp', 'w') as f:\n"
+        "        f.write(str(os.getpid()))\n"
+        "    os.rename(pidfile + '.tmp', pidfile)\n"
+        "    time.sleep(300)\n"
+        "ray_tpu.get(long_task.remote(sys.argv[2]), timeout=600)\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env = {**os.environ,
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    driver = subprocess.Popen(
+        [sys.executable, str(script), cluster.address, pidfile],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    try:
+        deadline = time.time() + 90
+        while not os.path.exists(pidfile) and time.time() < deadline:
+            time.sleep(0.2)
+        assert os.path.exists(pidfile), "sub-driver's task never started"
+        wpid = int(open(pidfile).read())
+        assert os.path.exists(f"/proc/{wpid}")
+
+        driver.send_signal(signal.SIGKILL)
+        driver.wait(timeout=10)
+
+        deadline = time.time() + 20
+        while os.path.exists(f"/proc/{wpid}") and time.time() < deadline:
+            time.sleep(0.2)
+        assert not os.path.exists(f"/proc/{wpid}"), (
+            "mid-task worker of a dead owner must be killed"
+        )
+
+        # the lease's CPU was released: a fresh task can run
+        @ray_tpu.remote(num_cpus=1)
+        def ping():
+            return "ok"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "ok"
+    finally:
+        driver.kill()
+        ray_tpu.shutdown()
+        cluster.shutdown()

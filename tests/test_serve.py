@@ -346,3 +346,54 @@ def test_multiplexed_models(serve_cluster):
     r5 = ray.get(handle.remote({"model": "m3", "x": 1}), timeout=60)
     assert r5["loads"] == ["m2", "m3", "m5", "m3"]  # m3 was evicted → reload
     serve.delete("multi")
+
+
+def test_abandoned_stream_reaped():
+    """A stream a client never drains must not leak in the replica: idle
+    entries are reaped on the next stream registration, and the underlying
+    generator is closed (ADVICE r4: serve/replica.py abandoned-stream leak)."""
+    from ray_tpu.serve import replica as replica_mod
+    from ray_tpu.serve.replica import ServeReplica
+
+    closed = []
+
+    def streamer(n):
+        try:
+            for i in range(n):
+                yield i
+        finally:
+            closed.append(n)
+
+    r = ServeReplica(streamer, (), {})
+    out1 = r.handle_request(3)
+    sid1 = out1["__serve_stream__"]
+    # partially drained, then abandoned
+    assert r.next_chunk(sid1) == {"done": False, "value": 0}
+
+    old = replica_mod.STREAM_IDLE_TIMEOUT_S
+    replica_mod.STREAM_IDLE_TIMEOUT_S = 0.0
+    try:
+        import time
+
+        time.sleep(0.01)
+        out2 = r.handle_request(5)  # registration triggers the reap
+    finally:
+        replica_mod.STREAM_IDLE_TIMEOUT_S = old
+    assert closed == [3]           # abandoned generator was close()d
+    assert sid1 not in r._streams  # and its entry dropped
+    # a reaped stream must surface an ERROR on next access, never a silent
+    # clean end-of-stream (the response would be truncated)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="reaped"):
+        r.next_chunk(sid1)
+    # the fresh stream still works end to end
+    sid2 = out2["__serve_stream__"]
+    got = []
+    while True:
+        c = r.next_chunk(sid2)
+        if c["done"]:
+            break
+        got.append(c["value"])
+    assert got == list(range(5))
+    assert r._streams == {}
