@@ -105,12 +105,15 @@ def main():
     # - remat=False: with the flash kernel there are no S×S residuals.
     # - fused CE (ops/cross_entropy.py): the f32 [B,S,V] log-softmax
     #   residual was 17 ms/step of pure HBM traffic (r4 profile).
-    # - flash blocks: fwd 256/1024 (whole-row kv → no online-softmax
-    #   rescale chain), bwd 512/512, fused single-pass backward kernel.
+    # - flash blocks (r5 sweep): fwd 256/512 with 6 heads/grid-step, bwd
+    #   512/512 with 3 (block_h amortizes per-step cost and lets the
+    #   causal loop skip the fully-masked kv tail; more heads OOM the
+    #   16 MB scoped VMEM). Fused single-pass backward kernel.
     cfg = gpt2.gpt2_124m(
         remat=False, scan_layers=False,
-        attn_block_q=256, attn_block_k=1024,
+        attn_block_q=256, attn_block_k=512,
         attn_bwd_block_q=512, attn_bwd_block_k=512,
+        attn_block_h=6, attn_bwd_block_h=3,
     )
     # fsdp over all local chips (== single-device mesh on one chip) so the
     # per-chip division below is honest on multi-chip hosts.
@@ -127,27 +130,41 @@ def main():
     global_batch, state = find_batch(
         bundle.step_fn, state, cfg, candidates=tuple(b * n_chips for b in per_chip)
     )
-    # Device-resident input, as the Train data path delivers it (the
-    # iterator device_puts prefetched batches; see data/iterator.py). A
-    # numpy batch would re-ship 400 KB through the host tunnel every step.
-    batch = jax.device_put(
-        synthetic_batch(cfg, global_batch=global_batch, seed=1),
-        {"tokens": bundle.data_sharding, "targets": bundle.data_sharding},
-    )
+    # Device-resident pre-staged batches, as the Train data path delivers
+    # them (the iterator device_puts prefetched batches; see
+    # data/iterator.py), stepped with the bundle's device-side train loop
+    # (multi_step_fn: lax.scan over the step axis — one dispatch for all N
+    # steps, the way MaxText-style TPU trainers run; per-step host dispatch
+    # through the tunnel costs ~3 ms/step otherwise).
+    import numpy as np
 
-    # warmup (compile already done in find_batch for this shape). The first
-    # ~10 post-compile executions run up to 3x slow on the tunnelled chip
-    # (measured round 3) — warm past them or the timing is garbage.
-    for _ in range(10):
-        state, m = bundle.step_fn(state, batch)
-    float(m["loss"])
+    steps = 50
+    stacked_sh = bundle.stacked_data_sharding
+    stacked = {
+        k: jax.device_put(
+            np.stack([
+                np.asarray(
+                    synthetic_batch(cfg, global_batch=global_batch,
+                                    seed=100 + i)[k]
+                )
+                for i in range(steps)
+            ]),
+            stacked_sh,
+        )
+        for k in ("tokens", "targets")
+    }
 
-    steps = 20
+    # warmup (compiles the scan; the first post-compile executions run slow
+    # on the tunnelled chip — warm past them or the timing is garbage)
+    state, ms = bundle.multi_step_fn(state, stacked)
+    float(ms["loss"][-1])
+    state, ms = bundle.multi_step_fn(state, stacked)
+    float(ms["loss"][-1])
+
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = bundle.step_fn(state, batch)
-    # host fetch: the steps chain through donated state, so this waits for
-    # the whole sequence
+    state, ms = bundle.multi_step_fn(state, stacked)
+    m = {"loss": ms["loss"][-1]}
+    # host fetch waits for the whole scanned sequence
     float(m["loss"])
     dt = time.perf_counter() - t0
 

@@ -56,6 +56,11 @@ class GPT2Config:
     attn_block_k: int = 512
     attn_bwd_block_q: int = 0   # 0 = same as attn_block_q
     attn_bwd_block_k: int = 0   # 0 = same as attn_block_k
+    # heads per kernel grid step (fwd/bwd): at hd=64 the kernels are
+    # grid-overhead bound; packing heads amortizes the per-step cost
+    # (must divide n_head; the kernel falls back to 1 otherwise)
+    attn_block_h: int = 1
+    attn_bwd_block_h: int = 0   # 0 = same as attn_block_h
     use_bias: bool = True
     # scan over layers (True: compact HLO, one traced block) vs an unrolled
     # Python loop (False: 12x the HLO, but no lax.scan slice/stack traffic —
@@ -248,9 +253,11 @@ def _layernorm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _attention(q, k, v, cfg: GPT2Config):
-    """q,k,v: [B, H, S, hd] → [B, H, S, hd], causal (head-major layout — the
-    flash kernels' native one, so the hot path has no boundary transposes)."""
+def _resolve_attention_impl(cfg: GPT2Config):
+    """Resolve attention_impl='auto' against the active mesh/backend.
+    Returns (impl, mesh, interpret) — interpret is the Pallas interpret-mode
+    choice (decided off the mesh's devices, not the process default backend;
+    None = let the kernel decide from the default backend)."""
     from ray_tpu.parallel import mesh as mesh_lib
 
     mesh = mesh_lib.current_mesh()
@@ -263,18 +270,26 @@ def _attention(q, k, v, cfg: GPT2Config):
             impl = "ring"
         else:
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    interpret = None
+    if mesh is not None:
+        interpret = mesh.devices.flat[0].platform != "tpu"
+    return impl, mesh, interpret
+
+
+def _attention(q, k, v, cfg: GPT2Config):
+    """q,k,v: [B, H, S, hd] → [B, H, S, hd], causal (head-major layout — the
+    flash kernels' native one, so the hot path has no boundary transposes)."""
+    impl, mesh, interpret = _resolve_attention_impl(cfg)
     if impl == "pallas":
         from ray_tpu.ops.attention import flash_attention
 
-        interpret = None
-        if mesh is not None:
-            # decide off the mesh's devices, not the process default backend
-            interpret = mesh.devices.flat[0].platform != "tpu"
         return flash_attention(
             q, k, v, causal=True, interpret=interpret, layout="bhsd",
             block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
             bwd_block_q=cfg.attn_bwd_block_q or None,
             bwd_block_k=cfg.attn_bwd_block_k or None,
+            block_h=cfg.attn_block_h,
+            bwd_block_h=cfg.attn_bwd_block_h or None,
         )
     if impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention_sharded
@@ -309,10 +324,11 @@ def _block(x, layer_params, cfg: GPT2Config):
     dt = cfg.dtype
     h = _layernorm(x, p["ln1_scale"], p["ln1_bias"])
     # head-major projection, one einsum per q/k/v: each matmul writes its
-    # output directly in the flash kernels' [B, H, S, hd] layout. A single
-    # fused [3,B,H,S,hd] einsum leaves XLA slicing+copying 36 MB per tensor
-    # to feed the custom-call (~3% of the step); three dots with the right
-    # output layout have no boundary copies at all.
+    # output directly in the flash kernels' [B, H, S, hd] layout (XLA emits
+    # transposed-output dots with NO separate formatting op — measured 0.04
+    # ms/step). A packed single [D, 3·H·hd] dot was tried (round 5): it
+    # saved 7 ms of matmul but XLA materialized 12.5 ms/step of layout
+    # glue for the rank-5 transposed output — net loss.
     w, b = p["qkv_w"].astype(dt), p["qkv_b"].astype(dt)
     q, k, v = (
         jnp.einsum("bsd,dhk->bhsk", h, w[:, i]) + b[i][None, :, None, :]

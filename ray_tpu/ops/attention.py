@@ -63,20 +63,18 @@ def _use_interpret() -> bool:
 
 def _fwd_kernel(
     q_off_ref, kv_off_ref,            # scalar prefetch: global offsets [1]
-    q_ref, k_ref, v_ref,              # [1, 1, bq, hd], [1, 1, Skv, hd] ×2
-    o_ref, lse_ref,                   # [1, 1, bq, hd], [1, 1, 1, bq]
-    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+    q_ref, k_ref, v_ref,              # [1, bh, bq, hd], [1, bh, Skv, hd] ×2
+    *rest,                            # [mask_ref,] o_ref, lse_ref
+    scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+    block_h: int = 1, mask_input: bool = False,
 ):
+    if mask_input:
+        mask_ref, o_ref, lse_ref = rest
+    else:
+        mask_ref = None
+        o_ref, lse_ref = rest
     qi = pl.program_id(2)
-    # fold the softmax scale into q once — a per-block [bq, bk] f32 multiply
-    # otherwise rides every inner iteration
-    q = q_ref[0, 0, :, :] * jnp.asarray(scale, q_ref.dtype)
-    hd = q.shape[-1]
     q_global = q_off_ref[0] + qi * block_q
-
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, hd), jnp.float32)
 
     nk = kv_len // block_k
     if causal:
@@ -92,63 +90,107 @@ def _fwd_kernel(
         num_blocks = nk
         num_full = nk
 
-    def make_body(masked):
-        def body(ki, carry):
-            m, l, acc = carry
-            k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
-            s = lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            if masked:
-                rows = q_global + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                cols = kv_off_ref[0] + ki * block_k + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s = jnp.where(rows >= cols, s, _NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
-            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
-            acc = acc * alpha + lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l, acc
-        return body
+    # heads are independent; processing block_h of them per grid step
+    # amortizes the per-step grid/DMA overhead (the attention matmuls are
+    # tiny at hd=64 — the kernel is overhead-bound, not FLOP-bound)
+    for hh in range(block_h):
+        # fold the softmax scale into q once — a per-block [bq, bk] f32
+        # multiply otherwise rides every inner iteration
+        q = q_ref[0, hh, :, :] * jnp.asarray(scale, q_ref.dtype)
+        hd = q.shape[-1]
 
-    carry = lax.fori_loop(0, num_full, make_body(False), (m0, l0, acc0))
-    m, l, acc = lax.fori_loop(
-        num_full, num_blocks, make_body(causal), carry
-    )
-    # rows with no valid kv (ring attention future chunks): l == 0 → output 0,
-    # lse = -inf-ish so the ring merge gives them zero weight.
-    l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[0, 0, :, :] = (acc / l_safe).astype(o_ref.dtype)
-    lse = jnp.where(
-        l[:, 0] > 0, m[:, 0] + jnp.log(l_safe[:, 0]), _NEG_INF
-    )
-    lse_ref[0, 0, 0, :] = lse
+        m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc0 = jnp.zeros((block_q, hd), jnp.float32)
+
+        def make_body(masked, hh=hh):
+            def body(ki, carry):
+                m, l, acc = carry
+                k = k_ref[0, hh, pl.ds(ki * block_k, block_k), :]
+                s = lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if masked:
+                    if mask_input:
+                        # additive mask DMA'd per q-block (shared across the
+                        # block_h heads): ONE vector add versus the 4 VPU
+                        # passes of iota×2 + compare + select — the kernel is
+                        # VPU-bound, so mask arithmetic is step time
+                        s = s + mask_ref[0, :, pl.ds(ki * block_k, block_k)]
+                    else:
+                        rows = q_global + lax.broadcasted_iota(
+                            jnp.int32, (block_q, block_k), 0
+                        )
+                        cols = (kv_off_ref[0] + ki * block_k
+                                + lax.broadcasted_iota(
+                                    jnp.int32, (block_q, block_k), 1))
+                        s = jnp.where(rows >= cols, s, _NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                v = v_ref[0, hh, pl.ds(ki * block_k, block_k), :]
+                acc = acc * alpha + lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return m_new, l, acc
+            return body
+
+        carry = lax.fori_loop(0, num_full, make_body(False), (m0, l0, acc0))
+        m, l, acc = lax.fori_loop(
+            num_full, num_blocks, make_body(causal), carry
+        )
+        # rows with no valid kv (ring attention future chunks): l == 0 →
+        # output 0, lse = -inf-ish so the ring merge gives them zero weight.
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0, hh, :, :] = (acc / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(
+            l[:, 0] > 0, m[:, 0] + jnp.log(l_safe[:, 0]), _NEG_INF
+        )
+        lse_ref[0, hh, 0, :] = lse
 
 
 def _mha_forward_bhsd(
     q, k, v, q_offset, kv_offset, *,
     causal: bool, scale: float, block_q: int, block_k: int,
-    interpret: bool,
+    interpret: bool, block_h: int = 1, mask_ok: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """q,k,v: [B, H, S, hd] → (o [B,H,S,hd], lse [B,H,S])."""
     B, H, Sq, hd = q.shape
     Skv = k.shape[2]
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Skv, block_k)
-    grid = (B, H, Sq // bq)
+    bh = block_h if H % block_h == 0 else 1
+    grid = (B, H // bh, Sq // bq)
+    # Precomputed additive causal mask, only valid for zero offsets (the
+    # single-device path — ring attention passes live offsets and keeps the
+    # in-kernel iota mask). Head-independent: one [bq, Skv] plane per
+    # q-block index, DMA'd once per grid step and shared by all bh heads.
+    # Only worth it when several heads amortize the DMA and the [Sq, Skv]
+    # f32 plane stays small — at long sequences (e.g. LLaMA S=4096 → 64 MB)
+    # streaming the mask costs more bandwidth than the iota path costs VPU.
+    mask_input = causal and mask_ok and bh > 1 and Sq * Skv <= 2 ** 21
+    operands = [q_offset, kv_offset, q, k, v]
+    in_specs = [
+        pl.BlockSpec((1, bh, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
+        pl.BlockSpec((1, bh, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, bh, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+    ]
+    if mask_input:
+        rows = jnp.arange(Sq)[:, None]
+        cols = jnp.arange(Skv)[None, :]
+        mask = jnp.where(rows >= cols, 0.0, _NEG_INF).astype(jnp.float32)
+        operands.append(mask.reshape(Sq // bq, bq, Skv))
+        in_specs.append(
+            pl.BlockSpec((1, bq, Skv), lambda b, h, i, *_: (i, 0, 0))
+        )
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, kv_len=Skv,
+        block_q=bq, block_k=bk, kv_len=Skv, block_h=bh,
+        mask_input=mask_input,
     )
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -159,19 +201,15 @@ def _mha_forward_bhsd(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i, *_: (b, h, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, *_: (b, h, 0, i)),
+                pl.BlockSpec((1, bh, bq, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, bh, 1, bq), lambda b, h, i, *_: (b, h, 0, i)),
             ],
         ),
         out_shape=out_shape,
         interpret=interpret,
-    )(q_offset, kv_offset, q, k, v)
+    )(*operands)
     return o, lse[:, :, 0, :]
 
 
@@ -184,6 +222,7 @@ def _fused_bwd_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dk_ref, dv_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, q_len: int,
+    block_h: int = 1,
 ):
     """Single-pass backward: grid over kv blocks; dk/dv written per block,
     dq accumulated into a whole-row VMEM-resident output (its index map is
@@ -193,10 +232,7 @@ def _fused_bwd_kernel(
     exp/mask VPU work — worth ~25% of backward time at GPT-2 shapes."""
     ki = pl.program_id(2)
     nk_total = pl.num_programs(2)
-    k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
-    hd = k.shape[-1]
-    block_k_ = k.shape[0]
+    block_k_ = k_ref.shape[2]
     kv_global = kv_off_ref[0] + ki * block_k_
 
     @pl.when(ki == 0)
@@ -214,68 +250,77 @@ def _fused_bwd_kernel(
         first_full = 0
 
     scale_c = jnp.asarray(scale, q_ref.dtype)
-    # dq contribution is ds @ (k*scale): folding the softmax scale into k
-    # here is one [bk, hd] multiply per grid step instead of per-pair work
-    k_scaled = k * scale_c
 
-    def make_body(masked):
-        def body(qi, carry):
-            dk, dv = carry
-            qs = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] * scale_c
-            do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
-            lse = lse_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
-            delta = delta_ref[0, 0, 0, pl.ds(qi * block_q, block_q)][:, None]
-            s = lax.dot_general(
-                qs, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            if masked:
-                rows = q_off_ref[0] + qi * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                cols = kv_global + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s = jnp.where(rows >= cols, s, _NEG_INF)
-            p = jnp.exp(s - lse)                     # [bq, bk]
-            dv = dv + lax.dot_general(
-                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            dp = lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta)
-            dk = dk + lax.dot_general(
-                ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            sl = pl.ds(qi * block_q, block_q)
-            dq_ref[0, 0, sl, :] += lax.dot_general(
-                ds.astype(k.dtype), k_scaled, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ).astype(dq_ref.dtype)
-            return dk, dv
-        return body
+    # heads are independent; block_h of them per grid step amortizes the
+    # per-step grid/DMA overhead (see _fwd_kernel)
+    for hh in range(block_h):
+        k = k_ref[0, hh, :, :]
+        v = v_ref[0, hh, :, :]
+        hd = k.shape[-1]
+        # dq contribution is ds @ (k*scale): folding the softmax scale into
+        # k here is one [bk, hd] multiply per grid step instead of per-pair
+        k_scaled = k * scale_c
 
-    dk0 = jnp.zeros((block_k_, hd), jnp.float32)
-    dv0 = jnp.zeros((block_k_, hd), jnp.float32)
-    carry = lax.fori_loop(first, first_full, make_body(causal), (dk0, dv0))
-    dk, dv = lax.fori_loop(first_full, nq, make_body(False), carry)
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+        def make_body(masked, hh=hh, k=k, v=v, k_scaled=k_scaled):
+            def body(qi, carry):
+                dk, dv = carry
+                qs = q_ref[0, hh, pl.ds(qi * block_q, block_q), :] * scale_c
+                do = do_ref[0, hh, pl.ds(qi * block_q, block_q), :]
+                lse = lse_ref[0, hh, 0, pl.ds(qi * block_q, block_q)][:, None]
+                delta = delta_ref[0, hh, 0, pl.ds(qi * block_q, block_q)][:, None]
+                s = lax.dot_general(
+                    qs, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if masked:
+                    rows = q_off_ref[0] + qi * block_q + lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0
+                    )
+                    cols = kv_global + lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1
+                    )
+                    s = jnp.where(rows >= cols, s, _NEG_INF)
+                p = jnp.exp(s - lse)                     # [bq, bk]
+                dv = dv + lax.dot_general(
+                    p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                dp = lax.dot_general(
+                    do, v, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                ds = p * (dp - delta)
+                dk = dk + lax.dot_general(
+                    ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                sl = pl.ds(qi * block_q, block_q)
+                dq_ref[0, hh, sl, :] += lax.dot_general(
+                    ds.astype(k.dtype), k_scaled, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(dq_ref.dtype)
+                return dk, dv
+            return body
+
+        dk0 = jnp.zeros((block_k_, hd), jnp.float32)
+        dv0 = jnp.zeros((block_k_, hd), jnp.float32)
+        carry = lax.fori_loop(first, first_full, make_body(causal), (dk0, dv0))
+        dk, dv = lax.fori_loop(first_full, nq, make_body(False), carry)
+        dk_ref[0, hh, :, :] = dk.astype(dk_ref.dtype)
+        dv_ref[0, hh, :, :] = dv.astype(dv_ref.dtype)
 
 
 def _mha_backward_bhsd(
     q, k, v, o, lse, do, q_offset, kv_offset, *,
     causal: bool, scale: float, block_q: int, block_k: int, interpret: bool,
+    block_h: int = 1,
 ):
     """All tensors [B, H, S, hd]; lse [B, H, S]. Returns dq, dk, dv."""
     B, H, Sq, hd = q.shape
     Skv = k.shape[2]
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Skv, block_k)
+    bh = block_h if H % block_h == 0 else 1
 
     # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.sum(
@@ -285,7 +330,7 @@ def _mha_backward_bhsd(
 
     fused_kernel = functools.partial(
         _fused_bwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, q_len=Sq,
+        block_q=bq, block_k=bk, q_len=Sq, block_h=bh,
     )
     # dq accumulates across kv grid steps → f32 output (bf16 accumulation
     # would drift with the number of kv blocks); cast at the end.
@@ -293,19 +338,19 @@ def _mha_backward_bhsd(
         fused_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B, H, Skv // bk),
+            grid=(B, H // bh, Skv // bk),
             in_specs=[
-                pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, 1, Sq), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, 1, Sq), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, bh, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, bh, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, bh, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, bh, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, bh, 1, Sq), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, bh, 1, Sq), lambda b, h, i, *_: (b, h, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, bh, Sq, hd), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, bh, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, bh, bk, hd), lambda b, h, i, *_: (b, h, i, 0)),
             ],
         ),
         out_shape=[
@@ -331,23 +376,23 @@ def _zero_off():
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 )
 def _flash(q, k, v, causal, scale, block_q, block_k, bwd_block_q,
-           bwd_block_k, interpret, bhsd):
+           bwd_block_k, interpret, bhsd, block_h, bwd_block_h):
     o, _ = _mha_forward_bhsd(
         q if bhsd else _to_bhsd(q),
         k if bhsd else _to_bhsd(k),
         v if bhsd else _to_bhsd(v),
         _zero_off(), _zero_off(),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, block_h=block_h, mask_ok=True,
     )
     return o if bhsd else _to_bhsd(o)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, bwd_block_q,
-               bwd_block_k, interpret, bhsd):
+               bwd_block_k, interpret, bhsd, block_h, bwd_block_h):
     if bhsd:
         qt, kt, vt = q, k, v
     else:
@@ -355,19 +400,19 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, bwd_block_q,
     o, lse = _mha_forward_bhsd(
         qt, kt, vt, _zero_off(), _zero_off(),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, block_h=block_h, mask_ok=True,
     )
     return (o if bhsd else _to_bhsd(o)), (qt, kt, vt, o, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, bwd_block_q, bwd_block_k,
-               interpret, bhsd, res, do):
+               interpret, bhsd, block_h, bwd_block_h, res, do):
     qt, kt, vt, o, lse = res
     dq, dk, dv = _mha_backward_bhsd(
         qt, kt, vt, o, lse, do if bhsd else _to_bhsd(do),
         _zero_off(), _zero_off(),
         causal=causal, scale=scale, block_q=bwd_block_q, block_k=bwd_block_k,
-        interpret=interpret,
+        interpret=interpret, block_h=bwd_block_h,
     )
     if bhsd:
         return dq, dk, dv
@@ -390,12 +435,18 @@ def flash_attention(
     bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     layout: str = "bshd",
+    block_h: int = 1,
+    bwd_block_h: Optional[int] = None,
 ) -> jax.Array:
     """Multi-head flash attention. q,k,v: [B, S, H, hd] → [B, S, H, hd]
     (layout="bshd", the default) or [B, H, S, hd] in and out
     (layout="bhsd" — the kernels' native layout; callers that can produce
     head-major tensors directly skip the boundary transposes entirely, worth
     ~3% of a GPT-2 train step on v5e).
+
+    block_h processes that many heads per grid step (must divide H; falls
+    back to 1 otherwise). At small head_dim the kernels are grid-overhead
+    bound, not FLOP bound — packing heads amortizes the per-step cost.
 
     Differentiable (custom VJP, flash backward). On non-TPU backends the
     kernels run in Pallas interpreter mode so tests validate the same code.
@@ -409,7 +460,7 @@ def flash_attention(
     return _flash(
         q, k, v, causal, scale, block_q, block_k,
         bwd_block_q or block_q, bwd_block_k or block_k,
-        interpret, layout == "bhsd",
+        interpret, layout == "bhsd", block_h, bwd_block_h or block_h,
     )
 
 

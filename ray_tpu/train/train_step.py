@@ -32,19 +32,80 @@ class TrainStepBundle:
     mesh: Mesh
     data_sharding: NamedSharding
     cfg: Any
+    # (state, batches) -> (state, stacked metrics): lax.scan over a leading
+    # step axis of pre-staged batches — ONE dispatch for N optimizer steps,
+    # hiding per-step host dispatch latency (the device loop MaxText-style
+    # trainers use). Batches: {"tokens": [N, B, S], "targets": [N, B, S]},
+    # placed with stacked_data_sharding.
+    multi_step_fn: Optional[Callable] = None
+    stacked_data_sharding: Optional[NamedSharding] = None
+
+
+def _scale_by_adam_lowmem(b1: float, b2: float, eps: float,
+                          moment_dtype) -> optax.GradientTransformation:
+    """scale_by_adam with BOTH moments stored in `moment_dtype` (bf16).
+
+    The optimizer pass is HBM-bandwidth floor (~4.3 ms/step at GPT-2-124M
+    on v5e); storing m and v in bf16 halves their read+write traffic
+    (~1.2 ms/step). All update arithmetic runs in f32 — only the stored
+    moments are rounded, a ~0.4% relative perturbation of the per-param
+    step size (far finer than 8-bit Adam variants in production use).
+    """
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p, dtype=moment_dtype)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(z, params),
+            nu=jax.tree.map(z, params),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        def upd(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = m.astype(jnp.float32) * b1 + g32 * (1 - b1)
+            v32 = v.astype(jnp.float32) * b2 + (g32 * g32) * (1 - b2)
+            mhat = m32 / (1 - b1 ** count.astype(jnp.float32))
+            vhat = v32 / (1 - b2 ** count.astype(jnp.float32))
+            step = mhat / (jnp.sqrt(vhat) + eps)
+            return step.astype(g.dtype), m32.astype(moment_dtype), v32.astype(moment_dtype)
+        out = jax.tree.map(upd, updates, state.mu, state.nu)
+        steps = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        mu = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        nu = jax.tree.map(lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return steps, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
 
 
 def default_optimizer(
     lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100,
     total_steps: int = 10_000, b1: float = 0.9, b2: float = 0.95,
-    grad_clip: float = 1.0,
+    grad_clip: float = 1.0, eps: float = 1e-8,
+    moment_dtype=jnp.bfloat16,
 ) -> optax.GradientTransformation:
+    """AdamW with warmup-cosine LR, global-norm clipping, and (by default)
+    bf16-stored moments (see _scale_by_adam_lowmem; pass
+    moment_dtype=jnp.float32 for classic f32 state).
+
+    NOTE: the bf16-moment default (round 5) changes the opt_state pytree
+    vs the earlier chain(clip, optax.adamw) — restoring a checkpoint taken
+    before then needs moment_dtype=jnp.float32 AND optax.adamw; structure
+    mismatches fail loudly at restore."""
     sched = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup, max(total_steps, warmup + 1), end_value=lr * 0.1
     )
+    if moment_dtype == jnp.float32:
+        scale = optax.scale_by_adam(b1=b1, b2=b2, eps=eps)
+    else:
+        scale = _scale_by_adam_lowmem(b1, b2, eps, moment_dtype)
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay),
+        scale,
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(sched),
     )
 
 
@@ -130,9 +191,33 @@ def make_gpt2_train_step(
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
-    return TrainStepBundle(
-        state=state, step_fn=step_fn, mesh=mesh, data_sharding=data_sh, cfg=cfg
+    multi_step_fn, stacked_sh = _make_multi_step(
+        step, state_shardings, data_sh, mesh
     )
+    return TrainStepBundle(
+        state=state, step_fn=step_fn, mesh=mesh, data_sharding=data_sh,
+        cfg=cfg, multi_step_fn=multi_step_fn, stacked_data_sharding=stacked_sh,
+    )
+
+
+def _make_multi_step(step, state_shardings, data_sh, mesh):
+    """Jit a device-side train loop: lax.scan of `step` over batches stacked
+    on a leading step axis (one dispatch for N optimizer steps)."""
+
+    def multi(state, batches):
+        return jax.lax.scan(step, state, batches)
+
+    stacked_sh = NamedSharding(mesh, P(None, *data_sh.spec))
+    multi_step_fn = jax.jit(
+        multi,
+        in_shardings=(
+            state_shardings,
+            {"tokens": stacked_sh, "targets": stacked_sh},
+        ),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+    return multi_step_fn, stacked_sh
 
 
 def make_llama_train_step(
@@ -198,8 +283,12 @@ def make_llama_train_step(
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
+    multi_step_fn, stacked_sh = _make_multi_step(
+        step, state_shardings, data_sh, mesh
+    )
     return TrainStepBundle(
-        state=state, step_fn=step_fn, mesh=mesh, data_sharding=data_sh, cfg=cfg
+        state=state, step_fn=step_fn, mesh=mesh, data_sharding=data_sh,
+        cfg=cfg, multi_step_fn=multi_step_fn, stacked_data_sharding=stacked_sh,
     )
 
 
