@@ -1,0 +1,12 @@
+#!/bin/bash
+# Build the ray_tpu C++ client library + examples.
+set -euo pipefail
+cd "$(dirname "$0")"
+mkdir -p build
+CXX=${CXX:-g++}
+FLAGS="-std=c++17 -O2 -Wall -Iinclude -Isrc"
+$CXX $FLAGS -fPIC -c src/pickle.cc -o build/pickle.o
+$CXX $FLAGS -fPIC -c src/client.cc -o build/client.o
+ar rcs build/libray_tpu_cpp.a build/pickle.o build/client.o
+$CXX $FLAGS examples/xlang_demo.cc build/libray_tpu_cpp.a -o build/xlang_demo
+echo "built: cpp/build/libray_tpu_cpp.a cpp/build/xlang_demo"

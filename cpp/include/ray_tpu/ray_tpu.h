@@ -1,0 +1,73 @@
+// ray_tpu C++ API: a thin driver over the ray:// client proxy.
+//
+// Parity: the reference's C++ user API (/root/reference/cpp/) and its thin
+// Ray Client (python/ray/util/client/). Design here follows the thin-client
+// shape deliberately: the proxy process owns the real objects and tasks on
+// behalf of this driver (ray_tpu/client/server.py), so the C++ side needs
+// no CoreWorker — just the session-authenticated RPC plane (core/rpc.py
+// framing) and the primitive Value model. Cross-language calls invoke
+// Python functions BY DESCRIPTOR ("pkg.mod:fn"), the same restriction as
+// the reference's cross-language support (cross_language.py).
+//
+// Usage:
+//   ray_tpu::Client ray;
+//   ray.Connect("127.0.0.1", 10001, token);
+//   auto ref = ray.Call("my_pkg.jobs:transform", {ray_tpu::Value(21)});
+//   ray_tpu::Value out = ray.Get(ref, /*timeout_s=*/60);
+#pragma once
+
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+
+struct ObjectRef {
+  std::string hex;  // object id, hex — resolved by the proxy's registry
+};
+
+class Client {
+ public:
+  Client();
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Connect to a ray:// client server. `token` is the cluster session
+  // token (RAY_TPU_TOKEN); sent as the auth preamble before any frame.
+  void Connect(const std::string& host, int port, const std::string& token);
+  void Close();
+  bool Connected() const;
+
+  // Cluster info (handle_connection_info): {"ray_version": ..., ...}
+  Value ConnectionInfo();
+
+  // Store a primitive value in the cluster object store.
+  ObjectRef Put(const Value& value);
+
+  // Fetch values; each must be a primitive tree. timeout_s <= 0 → no limit.
+  std::vector<Value> Get(const std::vector<ObjectRef>& refs, double timeout_s);
+  Value Get(const ObjectRef& ref, double timeout_s);
+
+  // Submit a task running the module-level Python function `func`
+  // ("pkg.mod:fn", plain or @ray_tpu.remote-decorated) with primitive
+  // args. Returns num_returns refs.
+  std::vector<ObjectRef> Call(const std::string& func, const ValueList& args,
+                              int num_returns = 1);
+
+  // Wait for up to timeout_s; returns (ready, pending).
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Wait(
+      const std::vector<ObjectRef>& refs, int num_returns, double timeout_s);
+
+  // Drop the proxy-side registry entries (frees the objects for GC).
+  void Release(const std::vector<ObjectRef>& refs);
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,75 @@
+// Value: the primitive object model shared between C++ callers and the
+// Python cluster. Cross-language payloads are restricted to this closed set
+// (None/bool/int/float/str/bytes/list/dict) — the same restriction the
+// reference places on cross-language arguments (msgpack-serializable); see
+// /root/reference/python/ray/cross_language.py.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Nil, Bool, Int, Float, Str, Bytes, List, Dict };
+
+  Value() : type_(Type::Nil) {}
+  Value(bool b) : type_(Type::Bool), int_(b ? 1 : 0) {}
+  Value(int i) : type_(Type::Int), int_(i) {}
+  Value(int64_t i) : type_(Type::Int), int_(i) {}
+  Value(double d) : type_(Type::Float), float_(d) {}
+  Value(const char* s) : type_(Type::Str), str_(s) {}
+  Value(std::string s) : type_(Type::Str), str_(std::move(s)) {}
+  static Value FromBytes(std::string b) {
+    Value v;
+    v.type_ = Type::Bytes;
+    v.str_ = std::move(b);
+    return v;
+  }
+  Value(ValueList l) : type_(Type::List), list_(std::make_shared<ValueList>(std::move(l))) {}
+  Value(ValueDict d) : type_(Type::Dict), dict_(std::make_shared<ValueDict>(std::move(d))) {}
+
+  Type type() const { return type_; }
+  bool is_nil() const { return type_ == Type::Nil; }
+
+  bool AsBool() const { Expect(Type::Bool); return int_ != 0; }
+  int64_t AsInt() const { Expect(Type::Int); return int_; }
+  double AsFloat() const {
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    Expect(Type::Float);
+    return float_;
+  }
+  const std::string& AsStr() const { Expect(Type::Str); return str_; }
+  const std::string& AsBytes() const { Expect(Type::Bytes); return str_; }
+  const ValueList& AsList() const { Expect(Type::List); return *list_; }
+  const ValueDict& AsDict() const { Expect(Type::Dict); return *dict_; }
+  ValueList& MutableList() { Expect(Type::List); return *list_; }
+  ValueDict& MutableDict() { Expect(Type::Dict); return *dict_; }
+
+ private:
+  void Expect(Type t) const {
+    if (type_ != t) {
+      throw std::runtime_error("ray_tpu::Value type mismatch (have " +
+                               std::to_string(static_cast<int>(type_)) +
+                               ", want " + std::to_string(static_cast<int>(t)) + ")");
+    }
+  }
+
+  Type type_;
+  int64_t int_ = 0;
+  double float_ = 0.0;
+  std::string str_;  // str or bytes payload
+  std::shared_ptr<ValueList> list_;
+  std::shared_ptr<ValueDict> dict_;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,196 @@
+#include "ray_tpu/ray_tpu.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+
+#include "pickle.h"
+
+namespace ray_tpu {
+
+namespace {
+// core/rpc.py frame header: 8-byte little-endian length
+std::string FrameHeader(uint64_t n) {
+  std::string h(8, '\0');
+  for (int i = 0; i < 8; i++) h[i] = static_cast<char>((n >> (8 * i)) & 0xff);
+  return h;
+}
+
+constexpr int kRequest = 0;
+constexpr int kResponse = 1;
+constexpr int kError = 2;
+constexpr int kPush = 3;
+constexpr const char* kAuthMagic = "RAYTPU-AUTH1 ";
+}  // namespace
+
+struct Client::Impl {
+  int fd = -1;
+  int64_t next_id = 1;
+  std::mutex mu;  // one in-flight call at a time (frames are ordered)
+
+  void SendAll(const char* data, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = ::send(fd, data + off, n - off, 0);
+      if (w <= 0) throw std::runtime_error("ray_tpu: connection lost (send)");
+      off += static_cast<size_t>(w);
+    }
+  }
+
+  void RecvAll(char* data, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::recv(fd, data + off, n - off, 0);
+      if (r <= 0) throw std::runtime_error("ray_tpu: connection lost (recv)");
+      off += static_cast<size_t>(r);
+    }
+  }
+
+  void SendFrame(const std::string& payload) {
+    std::string out = FrameHeader(payload.size()) + payload;
+    SendAll(out.data(), out.size());
+  }
+
+  std::string RecvFrame() {
+    char hdr[8];
+    RecvAll(hdr, 8);
+    uint64_t n = 0;
+    for (int i = 0; i < 8; i++)
+      n |= static_cast<uint64_t>(static_cast<unsigned char>(hdr[i])) << (8 * i);
+    if (n > (1ULL << 34)) throw std::runtime_error("ray_tpu: frame too large");
+    std::string data(n, '\0');
+    RecvAll(data.data(), n);
+    return data;
+  }
+
+  // One request/response round-trip; PUSH frames are skipped (this thin
+  // client subscribes to nothing).
+  Value CallMethod(const std::string& method, ValueDict payload) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (fd < 0) throw std::runtime_error("ray_tpu: not connected");
+    int64_t msg_id = next_id++;
+    Value frame(ValueList{Value(static_cast<int64_t>(kRequest)), Value(msg_id),
+                          Value(method), Value(std::move(payload))});
+    SendFrame(pickle::Encode(frame));
+    while (true) {
+      Value msg = pickle::Decode(RecvFrame());
+      const ValueList& parts = msg.AsList();
+      if (parts.size() != 4) throw std::runtime_error("ray_tpu: bad frame");
+      int64_t type = parts[0].AsInt();
+      if (type == kPush) continue;
+      if (parts[1].AsInt() != msg_id) continue;  // stale response
+      if (type == kResponse) return parts[3];
+      if (type == kError) {
+        const ValueDict& err = parts[3].AsDict();
+        throw std::runtime_error("ray_tpu: remote call " + method + " failed: " +
+                                 err.at("cls").AsStr() + "\n" + err.at("tb").AsStr());
+      }
+      throw std::runtime_error("ray_tpu: unexpected frame type");
+    }
+  }
+};
+
+Client::Client() : impl_(new Impl) {}
+Client::~Client() { Close(); }
+
+void Client::Connect(const std::string& host, int port, const std::string& token) {
+  Close();
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0 || !res)
+    throw std::runtime_error("ray_tpu: cannot resolve " + host);
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("ray_tpu: cannot connect to " + host);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+  impl_->fd = fd;
+  // auth preamble: first frame is the raw magic+token (core/rpc.py
+  // _accept_first_frame reads it before unpickling anything)
+  impl_->SendFrame(std::string(kAuthMagic) + token);
+}
+
+void Client::Close() {
+  if (impl_ && impl_->fd >= 0) {
+    ::close(impl_->fd);
+    impl_->fd = -1;
+  }
+}
+
+bool Client::Connected() const { return impl_->fd >= 0; }
+
+Value Client::ConnectionInfo() { return impl_->CallMethod("connection_info", {}); }
+
+ObjectRef Client::Put(const Value& value) {
+  ValueDict payload;
+  payload["blob"] = Value::FromBytes(pickle::Encode(value));
+  Value out = impl_->CallMethod("put_raw", std::move(payload));
+  return ObjectRef{out.AsStr()};
+}
+
+std::vector<Value> Client::Get(const std::vector<ObjectRef>& refs, double timeout_s) {
+  ValueList hexes;
+  for (const auto& r : refs) hexes.push_back(Value(r.hex));
+  ValueDict payload;
+  payload["oid_hexes"] = Value(std::move(hexes));
+  payload["get_timeout"] = timeout_s > 0 ? Value(timeout_s) : Value();
+  Value blob = impl_->CallMethod("get_raw", std::move(payload));
+  Value values = pickle::Decode(blob.AsBytes());
+  return values.AsList();
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  return Get(std::vector<ObjectRef>{ref}, timeout_s).at(0);
+}
+
+std::vector<ObjectRef> Client::Call(const std::string& func, const ValueList& args,
+                                    int num_returns) {
+  ValueDict payload;
+  payload["func"] = Value(func);
+  payload["args_blob"] = Value::FromBytes(pickle::Encode(Value(args)));
+  payload["num_returns"] = Value(static_cast<int64_t>(num_returns));
+  Value out = impl_->CallMethod("submit_named_task", std::move(payload));
+  std::vector<ObjectRef> refs;
+  for (const Value& h : out.AsList()) refs.push_back(ObjectRef{h.AsStr()});
+  return refs;
+}
+
+std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Client::Wait(
+    const std::vector<ObjectRef>& refs, int num_returns, double timeout_s) {
+  ValueList hexes;
+  for (const auto& r : refs) hexes.push_back(Value(r.hex));
+  ValueDict payload;
+  payload["oid_hexes"] = Value(std::move(hexes));
+  payload["num_returns"] = Value(static_cast<int64_t>(num_returns));
+  payload["wait_timeout"] = timeout_s > 0 ? Value(timeout_s) : Value();
+  Value out = impl_->CallMethod("wait", std::move(payload));
+  const ValueList& pair = out.AsList();
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> result;
+  for (const Value& h : pair.at(0).AsList()) result.first.push_back(ObjectRef{h.AsStr()});
+  for (const Value& h : pair.at(1).AsList()) result.second.push_back(ObjectRef{h.AsStr()});
+  return result;
+}
+
+void Client::Release(const std::vector<ObjectRef>& refs) {
+  ValueList hexes;
+  for (const auto& r : refs) hexes.push_back(Value(r.hex));
+  ValueDict payload;
+  payload["oid_hexes"] = Value(std::move(hexes));
+  impl_->CallMethod("release", std::move(payload));
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,325 @@
+#include "pickle.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace ray_tpu {
+namespace pickle {
+
+namespace {
+
+// ---- opcodes (pickletools names) ----
+constexpr char PROTO = '\x80';
+constexpr char FRAME = '\x95';
+constexpr char STOP = '.';
+constexpr char NONE = 'N';
+constexpr char NEWTRUE = '\x88';
+constexpr char NEWFALSE = '\x89';
+constexpr char BININT = 'J';
+constexpr char BININT1 = 'K';
+constexpr char BININT2 = 'M';
+constexpr char LONG1 = '\x8a';
+constexpr char BINFLOAT = 'G';
+constexpr char SHORT_BINUNICODE = '\x8c';
+constexpr char BINUNICODE = 'X';
+constexpr char BINUNICODE8 = '\x8d';
+constexpr char SHORT_BINBYTES = 'C';
+constexpr char BINBYTES = 'B';
+constexpr char BINBYTES8 = '\x8e';
+constexpr char EMPTY_TUPLE = ')';
+constexpr char TUPLE1 = '\x85';
+constexpr char TUPLE2 = '\x86';
+constexpr char TUPLE3 = '\x87';
+constexpr char TUPLE = 't';
+constexpr char MARK = '(';
+constexpr char EMPTY_LIST = ']';
+constexpr char APPEND = 'a';
+constexpr char APPENDS = 'e';
+constexpr char EMPTY_DICT = '}';
+constexpr char SETITEM = 's';
+constexpr char SETITEMS = 'u';
+constexpr char MEMOIZE = '\x94';
+constexpr char BINPUT = 'q';
+constexpr char LONG_BINPUT = 'r';
+constexpr char BINGET = 'h';
+constexpr char LONG_BINGET = 'j';
+
+void PutLE(std::string& out, uint64_t v, int nbytes) {
+  for (int i = 0; i < nbytes; i++) out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+void EncodeInto(std::string& out, const Value& v) {
+  switch (v.type()) {
+    case Value::Type::Nil:
+      out.push_back(NONE);
+      break;
+    case Value::Type::Bool:
+      out.push_back(v.AsBool() ? NEWTRUE : NEWFALSE);
+      break;
+    case Value::Type::Int: {
+      int64_t i = v.AsInt();
+      if (i >= -2147483648LL && i <= 2147483647LL) {
+        out.push_back(BININT);
+        PutLE(out, static_cast<uint32_t>(static_cast<int32_t>(i)), 4);
+      } else {
+        // LONG1: little-endian two's complement with minimal length
+        out.push_back(LONG1);
+        std::string body;
+        uint64_t u = static_cast<uint64_t>(i);
+        for (int n = 0; n < 8; n++) body.push_back(static_cast<char>((u >> (8 * n)) & 0xff));
+        // trim redundant sign bytes
+        while (body.size() > 1) {
+          unsigned char last = body[body.size() - 1], prev = body[body.size() - 2];
+          if ((last == 0x00 && !(prev & 0x80)) || (last == 0xff && (prev & 0x80)))
+            body.pop_back();
+          else
+            break;
+        }
+        out.push_back(static_cast<char>(body.size()));
+        out += body;
+      }
+      break;
+    }
+    case Value::Type::Float: {
+      out.push_back(BINFLOAT);
+      double d = v.AsFloat();
+      uint64_t bits;
+      std::memcpy(&bits, &d, 8);
+      for (int i = 7; i >= 0; i--) out.push_back(static_cast<char>((bits >> (8 * i)) & 0xff));
+      break;
+    }
+    case Value::Type::Str: {
+      const std::string& s = v.AsStr();
+      out.push_back(BINUNICODE);
+      PutLE(out, s.size(), 4);
+      out += s;
+      break;
+    }
+    case Value::Type::Bytes: {
+      const std::string& s = v.AsBytes();
+      out.push_back(BINBYTES);
+      PutLE(out, s.size(), 4);
+      out += s;
+      break;
+    }
+    case Value::Type::List: {
+      out.push_back(EMPTY_LIST);
+      out.push_back(MARK);
+      for (const Value& e : v.AsList()) EncodeInto(out, e);
+      out.push_back(APPENDS);
+      break;
+    }
+    case Value::Type::Dict: {
+      out.push_back(EMPTY_DICT);
+      out.push_back(MARK);
+      for (const auto& kv : v.AsDict()) {
+        out.push_back(BINUNICODE);
+        PutLE(out, kv.first.size(), 4);
+        out += kv.first;
+        EncodeInto(out, kv.second);
+      }
+      out.push_back(SETITEMS);
+      break;
+    }
+  }
+}
+
+class Decoder {
+ public:
+  explicit Decoder(const std::string& blob) : data_(blob) {}
+
+  Value Run() {
+    while (true) {
+      if (pos_ >= data_.size()) throw std::runtime_error("pickle: truncated");
+      char op = data_[pos_++];
+      switch (op) {
+        case PROTO:
+          Take(1);
+          break;
+        case FRAME:
+          Take(8);
+          break;
+        case STOP: {
+          if (stack_.empty()) throw std::runtime_error("pickle: empty at STOP");
+          return stack_.back();
+        }
+        case NONE: Push(Value()); break;
+        case NEWTRUE: Push(Value(true)); break;
+        case NEWFALSE: Push(Value(false)); break;
+        case BININT1: Push(Value(static_cast<int64_t>(U8()))); break;
+        case BININT2: {
+          // sequence the byte reads: operand evaluation order of `|` is
+          // unspecified, U8()|U8()<<8 could byte-swap on some compilers
+          int64_t lo = U8();
+          int64_t hi = U8();
+          Push(Value(lo | (hi << 8)));
+          break;
+        }
+        case BININT: {
+          uint32_t u = 0;
+          for (int i = 0; i < 4; i++) u |= static_cast<uint32_t>(U8()) << (8 * i);
+          Push(Value(static_cast<int64_t>(static_cast<int32_t>(u))));
+          break;
+        }
+        case LONG1: {
+          size_t n = U8();
+          if (n > 8) throw std::runtime_error("pickle: LONG1 too wide for int64");
+          uint64_t u = 0;
+          bool neg = false;
+          for (size_t i = 0; i < n; i++) {
+            uint8_t b = U8();
+            u |= static_cast<uint64_t>(b) << (8 * i);
+            if (i == n - 1) neg = b & 0x80;
+          }
+          if (neg && n < 8) u |= ~uint64_t(0) << (8 * n);
+          Push(Value(static_cast<int64_t>(u)));
+          break;
+        }
+        case BINFLOAT: {
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; i++) bits = (bits << 8) | U8();
+          double d;
+          std::memcpy(&d, &bits, 8);
+          Push(Value(d));
+          break;
+        }
+        case SHORT_BINUNICODE: Push(Value(TakeStr(U8()))); break;
+        case BINUNICODE: Push(Value(TakeStr(U32()))); break;
+        case BINUNICODE8: Push(Value(TakeStr(U64()))); break;
+        case SHORT_BINBYTES: Push(Value::FromBytes(TakeStr(U8()))); break;
+        case BINBYTES: Push(Value::FromBytes(TakeStr(U32()))); break;
+        case BINBYTES8: Push(Value::FromBytes(TakeStr(U64()))); break;
+        case EMPTY_TUPLE: Push(Value(ValueList{})); break;
+        case TUPLE1: {
+          Value a = Pop();
+          Push(Value(ValueList{a}));
+          break;
+        }
+        case TUPLE2: {
+          Value b = Pop(), a = Pop();
+          Push(Value(ValueList{a, b}));
+          break;
+        }
+        case TUPLE3: {
+          Value c = Pop(), b = Pop(), a = Pop();
+          Push(Value(ValueList{a, b, c}));
+          break;
+        }
+        case MARK: marks_.push_back(stack_.size()); break;
+        case TUPLE: {
+          ValueList items = PopToMark();
+          Push(Value(std::move(items)));
+          break;
+        }
+        case EMPTY_LIST: Push(Value(ValueList{})); break;
+        case APPEND: {
+          Value e = Pop();
+          stack_.back().MutableList().push_back(std::move(e));
+          break;
+        }
+        case APPENDS: {
+          ValueList items = PopToMark();
+          ValueList& dst = stack_.back().MutableList();
+          for (Value& e : items) dst.push_back(std::move(e));
+          break;
+        }
+        case EMPTY_DICT: Push(Value(ValueDict{})); break;
+        case SETITEM: {
+          Value v = Pop(), k = Pop();
+          stack_.back().MutableDict()[k.AsStr()] = std::move(v);
+          break;
+        }
+        case SETITEMS: {
+          ValueList items = PopToMark();
+          ValueDict& dst = stack_.back().MutableDict();
+          for (size_t i = 0; i + 1 < items.size(); i += 2)
+            dst[items[i].AsStr()] = std::move(items[i + 1]);
+          break;
+        }
+        case MEMOIZE: memo_.push_back(stack_.back()); break;
+        case BINPUT: {
+          size_t idx = U8();
+          if (memo_.size() <= idx) memo_.resize(idx + 1);
+          memo_[idx] = stack_.back();
+          break;
+        }
+        case LONG_BINPUT: {
+          size_t idx = U32();
+          if (memo_.size() <= idx) memo_.resize(idx + 1);
+          memo_[idx] = stack_.back();
+          break;
+        }
+        case BINGET: Push(memo_.at(U8())); break;
+        case LONG_BINGET: Push(memo_.at(U32())); break;
+        default:
+          throw std::runtime_error(
+              "pickle: unsupported opcode " + std::to_string(static_cast<unsigned char>(op)) +
+              " (non-primitive payload, or a protocol<3 producer?)");
+      }
+    }
+  }
+
+ private:
+  uint8_t U8() {
+    if (pos_ >= data_.size()) throw std::runtime_error("pickle: truncated");
+    return static_cast<uint8_t>(data_[pos_++]);
+  }
+  uint32_t U32() {
+    uint32_t u = 0;
+    for (int i = 0; i < 4; i++) u |= static_cast<uint32_t>(U8()) << (8 * i);
+    return u;
+  }
+  uint64_t U64() {
+    uint64_t u = 0;
+    for (int i = 0; i < 8; i++) u |= static_cast<uint64_t>(U8()) << (8 * i);
+    return u;
+  }
+  void Take(size_t n) {
+    // n > size-pos, not pos+n > size: the latter wraps for huge lengths
+    if (n > data_.size() - pos_) throw std::runtime_error("pickle: truncated");
+    pos_ += n;
+  }
+  std::string TakeStr(size_t n) {
+    if (n > data_.size() - pos_) throw std::runtime_error("pickle: truncated");
+    std::string s = data_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  void Push(Value v) { stack_.push_back(std::move(v)); }
+  Value Pop() {
+    if (stack_.empty()) throw std::runtime_error("pickle: stack underflow");
+    Value v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+  ValueList PopToMark() {
+    if (marks_.empty()) throw std::runtime_error("pickle: no mark");
+    size_t m = marks_.back();
+    marks_.pop_back();
+    ValueList items(stack_.begin() + m, stack_.end());
+    stack_.resize(m);
+    return items;
+  }
+
+  const std::string& data_;
+  size_t pos_ = 0;
+  std::vector<Value> stack_;
+  std::vector<size_t> marks_;
+  std::vector<Value> memo_;
+};
+
+}  // namespace
+
+std::string Encode(const Value& v) {
+  std::string out;
+  out.push_back(PROTO);
+  out.push_back('\x04');
+  EncodeInto(out, v);
+  out.push_back(STOP);
+  return out;
+}
+
+Value Decode(const std::string& blob) { return Decoder(blob).Run(); }
+
+}  // namespace pickle
+}  // namespace ray_tpu

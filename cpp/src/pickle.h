@@ -1,0 +1,26 @@
+// Bounded pickle codec for the cross-language control plane.
+//
+// The cluster's RPC frames are pickled tuples (core/rpc.py). A non-Python
+// client only ever needs the PRIMITIVE subset (Value): this codec encodes
+// Values with a handful of protocol-2/3 opcodes and decodes the opcode set
+// CPython's protocol-4/5 pickler emits for primitive trees. It refuses
+// anything outside that set (GLOBAL/REDUCE/etc.) — by construction it can
+// never instantiate arbitrary objects, so decoding is safe on this side.
+#pragma once
+
+#include <string>
+
+#include "ray_tpu/value.h"
+
+namespace ray_tpu {
+namespace pickle {
+
+// Encode a Value as a pickle blob Python's pickle.loads accepts.
+std::string Encode(const Value& v);
+
+// Decode a pickle blob of primitives into a Value (tuples become lists).
+// Throws std::runtime_error on unsupported opcodes or truncation.
+Value Decode(const std::string& blob);
+
+}  // namespace pickle
+}  // namespace ray_tpu

@@ -112,15 +112,18 @@ class ClientServer:
         ref = self._ray.put(self._loads(conn, blob))
         return self._register(conn, [ref])[0]
 
-    async def handle_get(self, conn, oid_hexes: list, get_timeout=None):
+    async def _get_values(self, conn, oid_hexes: list, get_timeout=None):
         # blocking cluster call → executor thread: a slow get from one
         # client must not stall the shared server loop (all other clients)
         reg = self._registry(conn)
         refs = [reg[h] for h in oid_hexes]
         loop = __import__("asyncio").get_running_loop()
-        values = await loop.run_in_executor(
+        return await loop.run_in_executor(
             None, lambda: self._ray.get(refs, timeout=get_timeout)
         )
+
+    async def handle_get(self, conn, oid_hexes: list, get_timeout=None):
+        values = await self._get_values(conn, oid_hexes, get_timeout)
         return cloudpickle.dumps(values)
 
     async def handle_wait(self, conn, oid_hexes: list, num_returns: int,
@@ -193,3 +196,51 @@ class ClientServer:
 
     def handle_nodes(self, conn):
         return self._ray.nodes()
+
+    # ---------------------------------------------------- cross-language API
+    # Parity: java/ + cpp/ call Python functions BY DESCRIPTOR via the same
+    # proxy pattern (reference cross_language.py). Payloads here are plain
+    # pickled PRIMITIVES (ints/floats/str/bytes/lists/dicts) so non-Python
+    # clients can speak them with a small codec (cpp/src/pickle.cc); the
+    # connection is already session-token authenticated before dispatch.
+
+    def handle_submit_named_task(self, conn, func: str, args_blob: bytes,
+                                 num_returns: int = 1, num_cpus=None):
+        """Submit a task calling the module-level function `func`
+        ("pkg.mod:name"), args from a primitive-pickle blob. Returns the
+        result ref hexes (registered to this client connection)."""
+        import importlib
+        import pickle
+
+        from ray_tpu.core.options import RemoteOptions
+        from ray_tpu.remote_function import RemoteFunction
+
+        if not isinstance(num_returns, int) or num_returns < 1:
+            raise ValueError(f"num_returns must be an int >= 1, got {num_returns!r}")
+        mod_name, _, fn_name = func.partition(":")
+        if not fn_name:
+            raise ValueError(f"function descriptor {func!r} must be 'module:name'")
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        fn = getattr(fn, "_function", fn)  # unwrap @ray_tpu.remote
+        args = pickle.loads(args_blob)
+        opts = RemoteOptions(num_returns=num_returns)
+        if num_cpus is not None:
+            opts.num_cpus = num_cpus
+        out = RemoteFunction(fn, opts).remote(*args)
+        refs = out if isinstance(out, (list, tuple)) else [out]
+        return self._register(conn, list(refs))
+
+    def handle_put_raw(self, conn, blob: bytes):
+        """Put a primitive-pickle value; returns its ref hex."""
+        import pickle
+
+        ref = self._ray.put(pickle.loads(blob))
+        return self._register(conn, [ref])[0]
+
+    async def handle_get_raw(self, conn, oid_hexes: list, get_timeout=None):
+        """Get values, replied as ONE plain-pickle blob of the value list
+        (values must be primitives for non-Python clients to decode)."""
+        import pickle
+
+        values = await self._get_values(conn, oid_hexes, get_timeout)
+        return pickle.dumps(values, protocol=4)

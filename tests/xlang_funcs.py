@@ -1,0 +1,23 @@
+"""Module-level functions the C++ cross-language demo calls by descriptor
+("tests.xlang_funcs:name" — see cpp/examples/xlang_demo.cc and
+ClientServer.handle_submit_named_task)."""
+
+
+def add(a, b):
+    return a + b
+
+
+def word_stats(text):
+    words = text.split()
+    out = {}
+    for w in words:
+        out[w] = out.get(w, 0) + 1
+    out["__total__"] = len(words)
+    return out
+
+
+def slow_echo(x, delay):
+    import time
+
+    time.sleep(delay)
+    return x
