@@ -8,6 +8,7 @@ reference structure this mirrors.
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.multi_agent_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.learner import (
     IMPALALearner,
     JaxLearner,
@@ -20,6 +21,7 @@ __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "EnvRunner",
+    "MultiAgentEnvRunner",
     "IMPALALearner",
     "JaxLearner",
     "LearnerGroup",

@@ -41,14 +41,34 @@ class AlgorithmConfig:
         # learner placement
         self.learner_mode = "local"   # "local" | "remote" (one accelerator actor)
         self.learner_remote_options: Dict[str, Any] = {"num_cpus": 1}
+        # multi-agent (config.multi_agent()): policy ids + agent→policy map
+        self.policies: Optional[List[str]] = None
+        self.policy_mapping_fn: Optional[Any] = None
+        self.env_kwargs: Dict[str, Any] = {}
         # extra per-algorithm knobs set by subclass-specific methods
         self.extra: Dict[str, Any] = {}
 
     # fluent sections, mirroring the reference's .environment()/.rollouts()/...
-    def environment(self, env: str, num_envs_per_worker: Optional[int] = None):
+    def environment(self, env: str, num_envs_per_worker: Optional[int] = None,
+                    env_kwargs: Optional[Dict[str, Any]] = None):
         self.env = env
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
+        if env_kwargs is not None:
+            self.env_kwargs = env_kwargs
+        return self
+
+    def multi_agent(self, policies=None, policy_mapping_fn=None):
+        """Enable multi-agent training (parity: AlgorithmConfig.multi_agent).
+
+        policies: list of policy ids. policy_mapping_fn(agent_id) -> policy
+        id; default maps every agent to the single policy (shared policy)
+        or round-robins agents over the given policies.
+        """
+        if policies is not None:
+            self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def rollouts(self, num_rollout_workers: Optional[int] = None,
@@ -228,31 +248,65 @@ class Algorithm(Trainable):
         }
 
     # -- Trainable ---------------------------------------------------------- #
+    # subclasses whose training_step computes its own episode stats (the
+    # multi-agent path reports per-agent windows) set this in setup()
+    _reports_own_episode_stats = False
+
     def step(self) -> Dict[str, Any]:
         result = self.training_step()
-        result.update(self._episode_stats())
+        if not self._reports_own_episode_stats:
+            result.update(self._episode_stats())
         return result
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def _all_learner_groups(self) -> Dict[str, Any]:
+        """Every learner group this algorithm owns, keyed for checkpoints:
+        the single-agent one under "__single__", multi-agent ones per
+        policy id."""
+        groups: Dict[str, Any] = {}
+        if getattr(self, "learner_group", None) is not None:
+            groups["__single__"] = self.learner_group
+        groups.update(getattr(self, "learner_groups", {}) or {})
+        return groups
+
     def save_checkpoint(self, checkpoint_dir: str):
-        return {"learner_state": self.learner_group.get_state(),
-                "config": self.algo_config.to_dict()}
+        return {
+            "learner_state": {
+                key: g.get_state() for key, g in self._all_learner_groups().items()
+            },
+            "config": self.algo_config.to_dict(),
+        }
 
     def load_checkpoint(self, checkpoint) -> None:
-        self.learner_group.set_state(checkpoint["learner_state"])
-        self._weights = self.learner_group.get_weights()
+        state = checkpoint["learner_state"]
+        if not isinstance(state, dict) or "__single__" not in state and not (
+            set(state) & set(getattr(self, "learner_groups", {}) or {})
+        ):
+            # legacy single-group checkpoint layout
+            state = {"__single__": state}
+        groups = self._all_learner_groups()
+        for key, s in state.items():
+            groups[key].set_state(s)
+        if getattr(self, "learner_group", None) is not None:
+            self._weights = self.learner_group.get_weights()
+        if getattr(self, "learner_groups", None):
+            self._ma_weights = {
+                pid: g.get_weights() for pid, g in self.learner_groups.items()
+            }
 
     def reset_config(self, new_config: Dict[str, Any]) -> bool:
         return False
 
     def get_weights(self):
+        if getattr(self, "learner_groups", None):
+            return self._ma_weights
         return self._weights
 
     def cleanup(self) -> None:
-        if getattr(self, "learner_group", None) is not None:
-            self.learner_group.shutdown()
+        for g in self._all_learner_groups().values():
+            g.shutdown()
         if self.workers:
             import ray_tpu
 

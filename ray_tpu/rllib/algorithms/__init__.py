@@ -2,8 +2,9 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
     "BC", "BCConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
-    "PPO", "PPOConfig",
+    "PPO", "PPOConfig", "SAC", "SACConfig",
 ]

@@ -33,6 +33,119 @@ class PPOConfig(AlgorithmConfig):
 class PPO(Algorithm):
     config_class = PPOConfig
 
+    # -------------------------------------------------------- multi-agent
+    # Parity: the reference's PPO trains a policy_map when
+    # config.multi_agent(policies=..., policy_mapping_fn=...) is set —
+    # each policy gets its own learner, fed the concatenation of its
+    # mapped agents' GAE'd streams (independent PPO; shared policy when
+    # several agents map to one id).
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if self.algo_config.policies:
+            self._setup_multi_agent()
+        else:
+            super().setup(config)
+
+    def _setup_multi_agent(self) -> None:
+        from ray_tpu.rllib.env.multi_agent import make_multi_agent_env
+        from ray_tpu.rllib.multi_agent_runner import MultiAgentEnvRunner
+
+        cfg = self.algo_config
+        if cfg.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        probe = make_multi_agent_env(cfg.env, 1, **cfg.env_kwargs)
+        self.obs_dim, self.num_actions = probe.obs_dim, probe.num_actions
+        pids = list(cfg.policies)
+        fn = cfg.policy_mapping_fn
+        if fn is None:
+            # default: shared single policy, else round-robin agents
+            fn = lambda aid: pids[probe.agent_ids.index(aid) % len(pids)]
+        mapping = {aid: fn(aid) for aid in probe.agent_ids}
+        unknown = set(mapping.values()) - set(pids)
+        if unknown:
+            raise ValueError(f"policy_mapping_fn returned unknown ids {unknown}")
+
+        runner_kwargs = dict(
+            env=cfg.env, policy_mapping=mapping,
+            num_envs=cfg.num_envs_per_worker, hiddens=tuple(cfg.hiddens),
+            gamma=cfg.gamma, lambda_=cfg.lambda_, seed=cfg.seed,
+            env_kwargs=cfg.env_kwargs,
+        )
+        if cfg.num_rollout_workers > 0:
+            import ray_tpu
+
+            remote_runner = ray_tpu.remote(num_cpus=1)(MultiAgentEnvRunner)
+            self.workers = [
+                remote_runner.remote(worker_index=i + 1, **runner_kwargs)
+                for i in range(cfg.num_rollout_workers)
+            ]
+            self.local_runner = None
+        else:
+            self.workers = []
+            self.local_runner = MultiAgentEnvRunner(
+                worker_index=0, **runner_kwargs
+            )
+        self.policy_mapping = mapping
+        self.learner_groups = {pid: self._make_learner_group() for pid in pids}
+        self._ma_weights = {
+            pid: g.get_weights() for pid, g in self.learner_groups.items()
+        }
+        # step() must keep this path's per-agent episode stats
+        self._reports_own_episode_stats = True
+
+    def _ma_training_step(self) -> Dict[str, Any]:
+        import numpy as np
+
+        cfg = self.algo_config
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        per_policy: Dict[str, list] = {pid: [] for pid in self.learner_groups}
+        ep_returns: Dict[str, list] = {}
+        steps = 0
+        # rounds of fragments until train_batch_size TOTAL env steps, the
+        # same contract as the single-agent sample_batch loop
+        while steps < cfg.train_batch_size:
+            if self.workers:
+                import ray_tpu
+
+                wref = ray_tpu.put(self._ma_weights)
+                outs = ray_tpu.get([
+                    w.sample.remote(cfg.rollout_fragment_length, wref)
+                    for w in self.workers
+                ])
+            else:
+                outs = [self.local_runner.sample(
+                    cfg.rollout_fragment_length, self._ma_weights
+                )]
+            ep_returns = {}
+            for batches, metrics in outs:
+                for pid, b in batches.items():
+                    per_policy[pid].append(b)
+                steps += metrics["num_env_steps"]
+                # rolling windows: keep only the LATEST snapshot per agent
+                for aid, rets in metrics["episode_returns_per_agent"].items():
+                    ep_returns.setdefault(aid, []).extend(rets[-20:])
+        stats: Dict[str, Any] = {"timesteps_this_iter": steps}
+        for pid, parts in per_policy.items():
+            if not parts:
+                continue
+            m = self.learner_groups[pid].update(
+                SampleBatch.concat_samples(parts)
+            )
+            stats[f"policy/{pid}/loss"] = m.get("loss")
+        self._ma_weights = {
+            pid: g.get_weights() for pid, g in self.learner_groups.items()
+        }
+        per_agent = {
+            aid: float(np.mean(r)) for aid, r in ep_returns.items() if r
+        }
+        stats["per_agent_reward_mean"] = per_agent
+        if per_agent:
+            stats["episode_reward_mean"] = float(
+                np.mean(list(per_agent.values()))
+            )
+        return stats
+
     def _make_learner_group(self) -> LearnerGroup:
         cfg = self.algo_config
         learner_kwargs = dict(
@@ -55,6 +168,8 @@ class PPO(Algorithm):
         )
 
     def training_step(self) -> Dict[str, Any]:
+        if self.algo_config.policies:
+            return self._ma_training_step()
         train_batch = self.sample_batch()
         metrics = self.learner_group.update(train_batch)
         self._weights = self.learner_group.get_weights()

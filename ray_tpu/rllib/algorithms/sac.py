@@ -1,0 +1,323 @@
+"""SAC (discrete): maximum-entropy off-policy actor-critic.
+
+Parity: rllib/algorithms/sac/ (SAC/SACConfig — the reference's soft
+actor-critic, whose discrete-action variant uses a categorical policy and
+twin Q networks). TPU-native shape mirrors DQN here: the whole update —
+twin soft-Q targets, policy (KL-to-Boltzmann) loss, temperature auto-tune,
+polyak target sync, Adam steps — is ONE jitted function over
+device-resident state; replay and the stochastic rollout loop stay
+host-side. Exploration is the policy's own entropy (act_mode
+"categorical"), so rollouts need no epsilon schedule.
+
+Learning target (reference tuned-example spirit): CartPole-v1
+episode_reward_mean >= 130.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import LearnerGroup
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class SACLearner:
+    """Jitted discrete-SAC update (twin Q + categorical policy + alpha)."""
+
+    def __init__(
+        self,
+        obs_dim: int,
+        num_actions: int,
+        hiddens=(64, 64),
+        lr: float = 3e-3,
+        grad_clip: float = 10.0,
+        gamma: float = 0.99,
+        tau: float = 0.01,
+        initial_alpha: float = 0.2,
+        autotune_alpha: bool = True,
+        target_entropy: float | None = None,
+        seed: int = 0,
+        **_unused,
+    ):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import (
+            mlp_actor_critic_apply,
+            mlp_actor_critic_init,
+        )
+
+        self.gamma = gamma
+        self.tau = tau
+        self.autotune = autotune_alpha
+        if target_entropy is None:
+            # discrete-SAC convention: a high fraction of max entropy
+            target_entropy = 0.6 * float(np.log(num_actions))
+        self.target_entropy = target_entropy
+        self._updates = 0
+
+        k = jax.random.PRNGKey(seed)
+        kp, k1, k2 = jax.random.split(k, 3)
+        # the policy rides the shared actor-critic module so the env runner's
+        # categorical act path works unchanged (vf head unused by SAC)
+        pi = mlp_actor_critic_init(kp, obs_dim, num_actions, tuple(hiddens))
+        q1 = mlp_actor_critic_init(k1, obs_dim, num_actions, tuple(hiddens))
+        q2 = mlp_actor_critic_init(k2, obs_dim, num_actions, tuple(hiddens))
+        params = {"pi": pi, "q1": q1, "q2": q2,
+                  "log_alpha": jnp.asarray(float(np.log(initial_alpha)))}
+        self._opt = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self._state = {
+            "params": params,
+            "target": {"q1": jax.tree.map(jnp.copy, q1),
+                       "q2": jax.tree.map(jnp.copy, q2)},
+            "opt_state": self._opt.init(params),
+        }
+
+        def q_of(net, obs):
+            # Q network reuses the module's policy head as Q-values
+            return mlp_actor_critic_apply(net, obs)[0]
+
+        def update(state, mb):
+            params, target = state["params"], state["target"]
+
+            def loss_fn(p):
+                logits, _ = mlp_actor_critic_apply(p["pi"], mb["obs"])
+                logpi = jax.nn.log_softmax(logits, axis=-1)
+                pi_probs = jnp.exp(logpi)
+                alpha = jnp.exp(p["log_alpha"])
+
+                # ---- twin soft-Q targets from the NEXT state's policy
+                nlogits, _ = mlp_actor_critic_apply(p["pi"], mb["next_obs"])
+                nlogpi = jax.nn.log_softmax(nlogits, axis=-1)
+                npi = jnp.exp(nlogpi)
+                tq = jnp.minimum(
+                    q_of(target["q1"], mb["next_obs"]),
+                    q_of(target["q2"], mb["next_obs"]),
+                )
+                v_next = jnp.sum(
+                    npi * (tq - jax.lax.stop_gradient(alpha) * nlogpi), axis=-1
+                )
+                y = mb["rewards"] + self.gamma * (1.0 - mb["dones"]) * (
+                    jax.lax.stop_gradient(v_next)
+                )
+
+                q1_all = q_of(p["q1"], mb["obs"])
+                q2_all = q_of(p["q2"], mb["obs"])
+                take = lambda q: jnp.take_along_axis(
+                    q, mb["actions"][:, None], axis=-1
+                )[:, 0]
+                td1 = take(q1_all) - y
+                td2 = take(q2_all) - y
+                q_loss = jnp.mean(mb["weights"] * (td1**2 + td2**2)) * 0.5
+
+                # ---- policy: minimize E_pi[alpha*logpi - minQ] (Q frozen)
+                q_min = jax.lax.stop_gradient(jnp.minimum(q1_all, q2_all))
+                pi_loss = jnp.mean(
+                    jnp.sum(
+                        pi_probs * (jax.lax.stop_gradient(alpha) * logpi - q_min),
+                        axis=-1,
+                    )
+                )
+
+                # ---- temperature: drive policy entropy toward the target
+                entropy = -jnp.sum(
+                    jax.lax.stop_gradient(pi_probs * logpi), axis=-1
+                )
+                alpha_loss = jnp.mean(
+                    jnp.exp(p["log_alpha"]) * (entropy - self.target_entropy)
+                ) if self.autotune else 0.0
+
+                loss = q_loss + pi_loss + alpha_loss
+                aux = (jnp.abs(td1), jnp.mean(entropy), alpha,
+                       q_loss, pi_loss)
+                return loss, aux
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            if not self.autotune:
+                grads["log_alpha"] = jnp.zeros_like(grads["log_alpha"])
+            import optax as _optax
+
+            updates, new_opt = self._opt.update(
+                grads, state["opt_state"], params
+            )
+            new_params = _optax.apply_updates(params, updates)
+            # polyak target sync every update (reference tau semantics)
+            new_target = jax.tree.map(
+                lambda t, o: (1.0 - self.tau) * t + self.tau * o,
+                target,
+                {"q1": new_params["q1"], "q2": new_params["q2"]},
+            )
+            new_state = {
+                "params": new_params,
+                "target": new_target,
+                "opt_state": new_opt,
+            }
+            return new_state, loss, aux
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: SampleBatch) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        dones = (
+            np.asarray(batch[SampleBatch.TERMINATEDS], np.float32)
+            + np.asarray(batch[SampleBatch.TRUNCATEDS], np.float32)
+        ).clip(0, 1)
+        mb = {
+            "obs": jnp.asarray(batch[SampleBatch.OBS], jnp.float32),
+            "actions": jnp.asarray(batch[SampleBatch.ACTIONS], jnp.int32),
+            "rewards": jnp.asarray(batch[SampleBatch.REWARDS], jnp.float32),
+            "next_obs": jnp.asarray(batch[SampleBatch.NEXT_OBS], jnp.float32),
+            "dones": jnp.asarray(dones),
+            "weights": jnp.asarray(
+                batch.get("weights", np.ones(len(batch), np.float32)),
+                jnp.float32,
+            ),
+        }
+        self._state, loss, aux = self._update(self._state, mb)
+        td_abs, entropy, alpha, q_loss, pi_loss = aux
+        self._updates += 1
+        return {
+            "loss": float(loss),
+            "q_loss": float(q_loss),
+            "pi_loss": float(pi_loss),
+            "alpha": float(alpha),
+            "policy_entropy": float(entropy),
+            "td_errors": np.asarray(td_abs),
+            "num_updates": self._updates,
+        }
+
+    def get_weights(self):
+        import jax
+
+        # the env runner only needs the categorical policy module
+        return jax.device_get(self._state["params"]["pi"])
+
+    def set_weights(self, pi_params) -> None:
+        self._state["params"]["pi"] = pi_params
+
+    def get_state(self):
+        import jax
+
+        return {"state": jax.device_get(self._state), "updates": self._updates}
+
+    def set_state(self, state) -> None:
+        self._state = state["state"]
+        self._updates = state["updates"]
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.lr = 3e-3
+        self.train_batch_size = 128
+        self.rollout_fragment_length = 4
+        self.num_envs_per_worker = 8
+        self.grad_clip = 10.0
+        self.buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.learning_starts = 1_000
+        self.tau = 0.01
+        self.initial_alpha = 0.2
+        self.autotune_alpha = True
+        self.target_entropy: float | None = None
+        self.train_intensity = 8
+
+    def training(self, **kwargs):
+        for k in (
+            "buffer_capacity", "prioritized_replay",
+            "prioritized_replay_alpha", "prioritized_replay_beta",
+            "learning_starts", "tau", "initial_alpha", "autotune_alpha",
+            "target_entropy", "train_intensity",
+        ):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def _runner_kwargs_extra(self) -> Dict[str, Any]:
+        # stochastic policy IS the exploration; replay-style transitions
+        return {"postprocess": "transitions", "act_mode": "categorical"}
+
+    def _make_learner_group(self) -> LearnerGroup:
+        cfg = self.algo_config
+        buffer_cls = (
+            PrioritizedReplayBuffer if cfg.prioritized_replay else ReplayBuffer
+        )
+        buffer_kwargs = dict(capacity=cfg.buffer_capacity, seed=cfg.seed)
+        if cfg.prioritized_replay:
+            buffer_kwargs.update(
+                alpha=cfg.prioritized_replay_alpha,
+                beta=cfg.prioritized_replay_beta,
+            )
+        self.buffer = buffer_cls(**buffer_kwargs)
+        self._env_steps = 0
+        return LearnerGroup(
+            SACLearner,
+            dict(
+                obs_dim=self.obs_dim,
+                num_actions=self.num_actions,
+                hiddens=tuple(cfg.hiddens),
+                lr=cfg.lr,
+                grad_clip=cfg.grad_clip,
+                gamma=cfg.gamma,
+                tau=cfg.tau,
+                initial_alpha=cfg.initial_alpha,
+                autotune_alpha=cfg.autotune_alpha,
+                target_entropy=cfg.target_entropy,
+                seed=cfg.seed,
+            ),
+            mode=cfg.learner_mode,
+            remote_options=cfg.learner_remote_options,
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+
+        if self.workers:
+            import ray_tpu
+
+            weights_ref = ray_tpu.put(self._weights)
+            outs = ray_tpu.get([
+                w.sample.remote(cfg.rollout_fragment_length, weights_ref)
+                for w in self.workers
+            ])
+        else:
+            outs = [self.local_runner.sample(
+                cfg.rollout_fragment_length, self._weights
+            )]
+        for batch, metrics in outs:
+            self.buffer.add(batch)
+            self._env_steps += len(batch)
+            self._merge_episode_metrics(metrics)
+
+        learn_metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.train_intensity):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                m = self.learner_group.update(mb)
+                td = m.pop("td_errors", None)
+                if td is not None and hasattr(self.buffer, "update_priorities"):
+                    self.buffer.update_priorities(mb["batch_indexes"], td)
+                learn_metrics = m
+            self._weights = self.learner_group.get_weights()
+
+        stats = self._episode_stats()
+        stats.update(learn_metrics)
+        stats["buffer_size"] = len(self.buffer)
+        stats["timesteps_this_iter"] = sum(len(b) for b, _ in outs)
+        return stats

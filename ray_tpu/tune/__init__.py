@@ -16,6 +16,8 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.trainable import Trainable, wrap_function
@@ -27,6 +29,8 @@ __all__ = [
     "ASHAScheduler",
     "BasicVariantGenerator",
     "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
     "Trainable",

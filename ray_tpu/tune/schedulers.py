@@ -40,11 +40,44 @@ class FIFOScheduler(TrialScheduler):
     pass
 
 
+class _Bracket:
+    """One successive-halving rung ladder (reference `_Bracket`)."""
+
+    def __init__(self, grace: int, max_t: int, rf: int):
+        self.rf = rf
+        self.rungs: List[int] = []
+        t = grace
+        while t < max_t:
+            self.rungs.append(t)
+            t *= rf
+        self.recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        self._trial_rung: Dict[str, int] = {}  # highest rung already recorded
+
+    def decide(self, trial_id: str, t: int, score: float) -> str:
+        # record once per rung crossing (reference _Bracket.on_result): each
+        # trial contributes exactly one score per rung, judged at that moment
+        done_rung = self._trial_rung.get(trial_id, 0)
+        for rung in reversed(self.rungs):
+            if t >= rung > done_rung:
+                self._trial_rung[trial_id] = rung
+                scores = self.recorded[rung]
+                scores.append(score)
+                k = max(1, len(scores) // self.rf)
+                cutoff = sorted(scores, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+                break
+        return CONTINUE
+
+
 class ASHAScheduler(TrialScheduler):
-    """Async successive halving: rungs at max_t/rf^k; a trial reaching a rung
+    """Async successive halving: rungs at grace·rf^k; a trial reaching a rung
     survives only if in the top 1/rf of results recorded at that rung.
 
-    Parity: tune/schedulers/async_hyperband.py (`_Bracket.on_result`).
+    `brackets > 1` runs several rung ladders with staggered grace periods
+    and assigns trials round-robin — the HyperBand bracket structure in its
+    asynchronous form (parity: tune/schedulers/async_hyperband.py, which
+    exposes the same `brackets` knob).
     """
 
     def __init__(
@@ -53,19 +86,28 @@ class ASHAScheduler(TrialScheduler):
         max_t: int = 100,
         grace_period: int = 1,
         reduction_factor: int = 4,
+        brackets: int = 1,
     ):
         self.time_attr = time_attr
         self.max_t = max_t
         self.grace = grace_period
         self.rf = reduction_factor
-        # rung milestones ascending: grace, grace*rf, grace*rf^2, ... < max_t
-        self.rungs: List[int] = []
-        t = grace_period
-        while t < max_t:
-            self.rungs.append(t)
-            t *= reduction_factor
-        self.recorded: Dict[int, List[float]] = {r: [] for r in self.rungs}
-        self._trial_rung: Dict[str, int] = {}  # highest rung already recorded
+        # bracket b starts its ladder at grace*rf^b (reference AsyncHyperBand)
+        self.brackets = [
+            _Bracket(grace_period * (reduction_factor ** b), max_t,
+                     reduction_factor)
+            for b in range(max(1, brackets))
+        ]
+        self._trial_bracket: Dict[str, _Bracket] = {}
+        self._next_bracket = 0
+
+    def _bracket_for(self, trial_id: str) -> _Bracket:
+        b = self._trial_bracket.get(trial_id)
+        if b is None:
+            b = self.brackets[self._next_bracket % len(self.brackets)]
+            self._next_bracket += 1
+            self._trial_bracket[trial_id] = b
+        return b
 
     def on_result(self, trial, result):
         t = result.get(self.time_attr, 0)
@@ -74,20 +116,92 @@ class ASHAScheduler(TrialScheduler):
         value = result.get(self.metric)
         if value is None:
             return CONTINUE
-        score = self._score(float(value))
-        # record once per rung crossing (reference _Bracket.on_result): each
-        # trial contributes exactly one score per rung, judged at that moment
-        done_rung = self._trial_rung.get(trial.trial_id, 0)
-        for rung in reversed(self.rungs):
-            if t >= rung > done_rung:
-                self._trial_rung[trial.trial_id] = rung
-                scores = self.recorded[rung]
-                scores.append(score)
-                k = max(1, len(scores) // self.rf)
-                cutoff = sorted(scores, reverse=True)[k - 1]
-                if score < cutoff:
-                    return STOP
-                break
+        return self._bracket_for(trial.trial_id).decide(
+            trial.trial_id, t, self._score(float(value))
+        )
+
+
+class HyperBandScheduler(ASHAScheduler):
+    """HyperBand: the full bracket portfolio (one ladder per aggressiveness
+    level, trials spread across them).
+
+    Deliberate redesign vs the reference's SYNCHRONOUS HyperBandScheduler
+    (tune/schedulers/hyperband.py): that version pauses whole bands until
+    every member reaches the milestone, which serializes on the slowest
+    trial; this one makes each bracket's halving decision asynchronously
+    (the reference's own docs recommend the async form for exactly that
+    reason). Defaults to the max useful bracket count for (max_t, rf).
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        brackets: Optional[int] = None,
+    ):
+        if brackets is None:
+            # ladders remain non-trivial while grace*rf^b < max_t
+            brackets = max(
+                1, int(math.log(max_t / grace_period) / math.log(reduction_factor))
+            )
+        super().__init__(
+            time_attr=time_attr, max_t=max_t, grace_period=grace_period,
+            reduction_factor=reduction_factor, brackets=brackets,
+        )
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average result falls below the median of
+    the other trials' running averages at the same point in training.
+
+    Parity: tune/schedulers/median_stopping_rule.py — grace period before
+    any stopping, a minimum number of completed-enough peers before the
+    median is trusted, and comparison on the running mean of the metric.
+    """
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+        hard_stop: bool = True,
+    ):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.hard_stop = hard_stop
+        # trial_id -> scores in report order (prefix sums would also do;
+        # trials report tens-to-hundreds of results, a list is fine)
+        self._hist: Dict[str, List[float]] = {}
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        hist = self._hist.setdefault(trial.trial_id, [])
+        hist.append(self._score(float(value)))
+        if t < self.grace:
+            return CONTINUE
+        # Time-aligned comparison (reference median_stopping_rule.py): the
+        # trial's running mean over its k reports vs the median of PEERS'
+        # running means over their FIRST k reports — a late-starting trial
+        # is never judged against mature trials' full-run means.
+        k = len(hist)
+        others = [
+            sum(h[:k]) / min(len(h), k)
+            for tid, h in self._hist.items()
+            if tid != trial.trial_id and h
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mean = sum(hist) / k
+        if mean < median:
+            return STOP if self.hard_stop else CONTINUE
         return CONTINUE
 
 

@@ -27,6 +27,7 @@ def client_server():
     import ray_tpu
     from ray_tpu.client import ClientServer
 
+    _build()  # once per module; both tests run the same artifact
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, num_tpus=0)
     server = ClientServer(host="127.0.0.1", port=0)
@@ -41,7 +42,6 @@ def test_cpp_demo_end_to_end(client_server):
     from ray_tpu.core import rpc
 
     host, port = client_server
-    _build()
     token = rpc.get_auth_token() or ""
     env = {**os.environ,
            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
@@ -62,7 +62,6 @@ def test_cpp_demo_end_to_end(client_server):
 
 def test_cpp_demo_rejects_bad_token(client_server):
     host, port = client_server
-    _build()
     out = subprocess.run(
         [DEMO, host, str(port), "wrong-token"],
         capture_output=True, timeout=60,

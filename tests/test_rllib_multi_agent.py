@@ -1,0 +1,131 @@
+"""Multi-agent RLlib: MultiAgentEnv, policy mapping, shared + independent
+policies under PPO.
+
+Parity: rllib/env/multi_agent_env.py + the policy_map/policy_mapping_fn
+machinery of rollout workers; MultiAgentCartPole mirrors the reference's
+example env.
+"""
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms import PPOConfig
+
+
+def test_multi_agent_env_and_runner_mapping():
+    """Env: dict-keyed per-agent arrays. Runner: each policy's batch holds
+    exactly its mapped agents' rows (shared policy concatenates streams)."""
+    from ray_tpu.rllib.env.multi_agent import MultiAgentCartPole
+    from ray_tpu.rllib.multi_agent_runner import MultiAgentEnvRunner
+
+    env = MultiAgentCartPole(num_agents=3, num_envs=4)
+    obs = env.reset(seed=0)
+    assert sorted(obs) == ["agent_0", "agent_1", "agent_2"]
+    assert obs["agent_0"].shape == (4, env.obs_dim)
+    o, r, te, tr = env.step({a: np.zeros(4, np.int64) for a in env.agent_ids})
+    assert all(r[a].shape == (4,) for a in env.agent_ids)
+
+    runner = MultiAgentEnvRunner(
+        "MultiAgentCartPole",
+        policy_mapping={"agent_0": "left", "agent_1": "left",
+                        "agent_2": "right"},
+        num_envs=4, hiddens=(16,), seed=0,
+        env_kwargs={"num_agents": 3},
+    )
+    batches, metrics = runner.sample(16)
+    assert sorted(batches) == ["left", "right"]
+    # left serves two agents -> twice the rows of right
+    assert len(batches["left"]) == 2 * len(batches["right"]) == 2 * 16 * 4
+    assert "advantages" in batches["left"]
+    assert metrics["num_env_steps"] == 16 * 4 * 3
+
+
+def test_shared_policy_learns_multi_agent_cartpole():
+    """config.multi_agent with ONE shared policy: both agents' streams train
+    one policy and both agents' returns reach the target."""
+    algo = (
+        PPOConfig()
+        .environment("MultiAgentCartPole", num_envs_per_worker=8,
+                     env_kwargs={"num_agents": 2})
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=128)
+        .multi_agent(policies=["shared"],
+                     policy_mapping_fn=lambda aid: "shared")
+        .training(lr=3e-4, num_epochs=8, minibatch_size=256)
+        .debugging(seed=0)
+        .build()
+    )
+    best = {}
+    for i in range(60):
+        res = algo.train()
+        for aid, v in res.get("per_agent_reward_mean", {}).items():
+            best[aid] = max(best.get(aid, -np.inf), v)
+        if len(best) == 2 and min(best.values()) >= 150:
+            break
+    assert len(best) == 2 and min(best.values()) >= 150, best
+
+
+def test_independent_policies_train_separately():
+    """Two policies via mapping fn: each updates from its own agent's data
+    (weights diverge) and both learn."""
+    import jax
+
+    algo = (
+        PPOConfig()
+        .environment("MultiAgentCartPole", num_envs_per_worker=8,
+                     env_kwargs={"num_agents": 2})
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=128)
+        .multi_agent(policies=["p0", "p1"],
+                     policy_mapping_fn=lambda aid: "p" + aid[-1])
+        .training(lr=3e-4, num_epochs=8, minibatch_size=256)
+        .debugging(seed=0)
+        .build()
+    )
+    assert algo.policy_mapping == {"agent_0": "p0", "agent_1": "p1"}
+    best = {}
+    for i in range(80):
+        res = algo.train()
+        for aid, v in res.get("per_agent_reward_mean", {}).items():
+            best[aid] = max(best.get(aid, -np.inf), v)
+        if len(best) == 2 and min(best.values()) >= 150:
+            break
+    assert len(best) == 2 and min(best.values()) >= 150, best
+    w0 = algo._ma_weights["p0"]
+    w1 = algo._ma_weights["p1"]
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()), w0, w1
+    ))
+    assert max(diffs) > 1e-3  # trained on different data -> diverged
+
+
+def test_multi_agent_checkpoint_roundtrip(tmp_path):
+    """save_checkpoint/load_checkpoint carry every policy's learner state
+    (the Algorithm base knows about multi-policy learner groups)."""
+    import jax
+
+    def build():
+        return (
+            PPOConfig()
+            .environment("MultiAgentCartPole", num_envs_per_worker=4,
+                         env_kwargs={"num_agents": 2})
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=32)
+            .multi_agent(policies=["p0", "p1"],
+                         policy_mapping_fn=lambda aid: "p" + aid[-1])
+            .training(train_batch_size=256, num_epochs=2, minibatch_size=64)
+            .debugging(seed=0)
+            .build()
+        )
+
+    algo = build()
+    algo.train()
+    ckpt = algo.save_checkpoint(str(tmp_path))
+    assert set(ckpt["learner_state"]) == {"p0", "p1"}
+
+    algo2 = build()
+    algo2.load_checkpoint(ckpt)
+    for pid in ("p0", "p1"):
+        diffs = jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            algo.get_weights()[pid], algo2.get_weights()[pid],
+        ))
+        assert max(diffs) == 0.0, (pid, max(diffs))
+    algo.cleanup()
+    algo2.cleanup()

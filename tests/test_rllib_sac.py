@@ -1,0 +1,76 @@
+"""SAC (discrete): max-entropy off-policy actor-critic.
+
+Parity: rllib/algorithms/sac/ — learning regression in the tuned-example
+spirit (CartPole episode_reward_mean >= 150 like the other algos).
+"""
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def test_sac_learner_update_mechanics():
+    """One jitted update: losses finite, temperature moves toward the
+    entropy target, polyak target actually tracks the online Q nets."""
+    import jax
+
+    from ray_tpu.rllib.algorithms.sac import SACLearner
+
+    rng = np.random.default_rng(0)
+    n, obs_dim, num_actions = 256, 4, 2
+    batch = SampleBatch({
+        SampleBatch.OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        SampleBatch.ACTIONS: rng.integers(0, num_actions, n),
+        SampleBatch.REWARDS: rng.normal(size=n).astype(np.float32),
+        SampleBatch.NEXT_OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        SampleBatch.TERMINATEDS: np.zeros(n, bool),
+        SampleBatch.TRUNCATEDS: np.zeros(n, bool),
+    })
+    learner = SACLearner(obs_dim, num_actions, hiddens=(32,), lr=3e-3,
+                         tau=0.05, seed=0)
+    t0 = jax.tree.map(np.asarray, learner._state["target"])
+    m = None
+    for _ in range(20):
+        m = learner.update(batch)
+    assert np.isfinite(m["loss"]) and np.isfinite(m["alpha"])
+    assert 0.0 < m["policy_entropy"] <= np.log(num_actions) + 1e-6
+    assert m["td_errors"].shape == (n,)
+    # targets moved toward the online nets (polyak, not frozen)
+    t1 = learner._state["target"]
+    moved = jax.tree.leaves(
+        jax.tree.map(lambda a, b: float(np.abs(np.asarray(b) - a).max()), t0, t1)
+    )
+    assert max(moved) > 0.0
+
+    # weights round-trip carries ONLY the policy module (what runners need)
+    w = learner.get_weights()
+    assert set(w.keys()) == {"pi", "vf"}
+    learner.set_weights(w)
+
+
+def test_sac_learns_cartpole():
+    """Learning regression: stochastic-policy exploration + twin soft-Q +
+    auto temperature reaches >= 150 on CartPole."""
+    from ray_tpu.rllib.algorithms import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("CartPole-v1", num_envs_per_worker=8)
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .training(
+            lr=3e-3,
+            train_batch_size=256,
+            learning_starts=500,
+            train_intensity=8,
+            hiddens=(64, 64),
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    best = -np.inf
+    for i in range(600):
+        res = algo.train()
+        best = max(best, res.get("episode_reward_mean", -np.inf))
+        if best >= 150:
+            break
+    assert best >= 150, f"SAC failed to learn CartPole: best={best}"

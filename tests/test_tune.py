@@ -207,3 +207,52 @@ class TestTunerOverJaxTrainer:
         assert grid.num_errors == 0
         best = grid.get_best_result()
         assert best.config["lr"] == 0.5
+
+
+class TestHyperBandAndMedian:
+    def test_hyperband_brackets_prune_and_keep_best(self, ray_start_local):
+        """Bracketed async halving: the best config survives to max_t, bad
+        ones are cut early, and trials actually spread across >1 bracket."""
+        from ray_tpu.tune import HyperBandScheduler
+
+        scheduler = HyperBandScheduler(max_t=16, grace_period=2,
+                                       reduction_factor=2)
+        assert len(scheduler.brackets) > 1  # a real bracket portfolio
+        tuner = Tuner(
+            _Quadratic,
+            param_space={"x": grid_search(
+                [0.0, 0.5, 1.0, 2.5, 3.0, 3.5, 5.0, 6.0])},
+            tune_config=TuneConfig(
+                metric="score", mode="max", scheduler=scheduler,
+                max_concurrent_trials=8,
+            ),
+            run_config=_stop(training_iteration=16),
+        )
+        grid = tuner.fit()
+        iters = {t.config["x"]: t.iteration for t in grid}
+        assert iters[3.0] == 16                  # the optimum survives
+        assert min(iters.values()) < 16          # something was pruned
+        assert len(set(scheduler._trial_bracket.values())) > 1
+        assert grid.get_best_result().config["x"] == 3.0
+
+    def test_median_stopping_rule(self, ray_start_local):
+        """Trials whose running mean is below the peer median stop early;
+        above-median trials run to completion."""
+        from ray_tpu.tune import MedianStoppingRule
+
+        scheduler = MedianStoppingRule(grace_period=3, min_samples_required=3)
+        tuner = Tuner(
+            _Quadratic,
+            param_space={"x": grid_search(
+                [0.0, 1.0, 2.5, 3.0, 3.5, 5.0, 6.0, 7.0])},
+            tune_config=TuneConfig(
+                metric="score", mode="max", scheduler=scheduler,
+                max_concurrent_trials=8,
+            ),
+            run_config=_stop(training_iteration=12),
+        )
+        grid = tuner.fit()
+        iters = {t.config["x"]: t.iteration for t in grid}
+        assert iters[3.0] == 12                  # near-optimum never stopped
+        assert iters[7.0] < 12                   # far-off config cut early
+        assert grid.get_best_result().config["x"] == 3.0
