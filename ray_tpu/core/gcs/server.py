@@ -278,7 +278,9 @@ class GcsServer:
     # ------------------------------------------------------------- pubsub
     async def publish(self, channel: str, payload):
         dead = []
-        for conn in self.subscribers.get(channel, set()):
+        # snapshot: awaiting push suspends mid-iteration and a concurrent
+        # (un)subscribe for the same channel would mutate the live set
+        for conn in list(self.subscribers.get(channel, set())):
             try:
                 await conn.push(channel, payload)
             except rpc.ConnectionLost:
@@ -290,6 +292,19 @@ class GcsServer:
         for ch in channels:
             self.subscribers.setdefault(ch, set()).add(conn)
         return True
+
+    def handle_unsubscribe(self, conn, channels: List[str]):
+        for ch in channels:
+            self.subscribers.get(ch, set()).discard(conn)
+        return True
+
+    async def handle_publish(self, conn, channel: str, payload) -> int:
+        """General pubsub publish from any cluster process (reference:
+        src/ray/pubsub/ + gcs_pubsub.py). User channels arrive namespaced
+        ("user:*" — util/pubsub.py) so they can't collide with the internal
+        ones (logs, actor state); returns the subscriber count."""
+        await self.publish(channel, payload)
+        return len(self.subscribers.get(channel, ()))
 
     # -------------------------------------------------------------- nodes
     async def handle_register_node(

@@ -36,7 +36,15 @@ _MAX_FRAME = 1 << 34  # 16 GiB guard
 # valid token arrives. Set via RAY_TPU_TOKEN (cluster start generates one
 # and passes it to every daemon/worker through the environment).
 # --------------------------------------------------------------------------
-_AUTH_MAGIC = b"RAYTPU-AUTH1 "
+# Wire-protocol revision. The preamble doubles as the version handshake
+# (reference analog: the protobuf schema rev in src/ray/protobuf/ — here the
+# frames are pickled, so cross-version compatibility is gated explicitly):
+# bump PROTOCOL_VERSION whenever the frame format or a message's payload
+# contract changes incompatibly. A peer with a different rev is rejected
+# with a logged reason instead of failing deep inside unpickling.
+PROTOCOL_VERSION = 1
+_AUTH_PREFIX = b"RAYTPU-AUTH"
+_AUTH_MAGIC = _AUTH_PREFIX + str(PROTOCOL_VERSION).encode() + b" "
 _auth_token: Optional[str] = os.environ.get("RAY_TPU_TOKEN") or None
 
 
@@ -215,6 +223,18 @@ class Connection:
         if n <= 0 or n > _MAX_FRAME:
             return False
         data = await asyncio.wait_for(self.reader.readexactly(n), timeout=60)
+        if data.startswith(_AUTH_PREFIX) and not data.startswith(_AUTH_MAGIC):
+            # right framework, wrong protocol rev: say so loudly — the
+            # alternative is an opaque unpickling failure later
+            sep = data.find(b" ", 0, 32)  # bounded: never echo frame bytes
+            theirs = data[len(_AUTH_PREFIX):sep] if sep != -1 else b"?"
+            logger.warning(
+                "protocol version mismatch on %s from %s: peer speaks rev "
+                "%s, this node speaks rev %d; closing",
+                self.name, self.peername, theirs.decode("ascii", "replace"),
+                PROTOCOL_VERSION,
+            )
+            return False
         if data.startswith(_AUTH_MAGIC):
             if _auth_token is not None and not hmac.compare_digest(
                     data, _auth_frame_payload()):

@@ -272,11 +272,16 @@ class Algorithm(Trainable):
         return groups
 
     def save_checkpoint(self, checkpoint_dir: str):
+        # config rides along for inspection only (load_checkpoint ignores
+        # it); strip callables — policy_mapping_fn is usually a lambda and
+        # Trainable.save pickles this whole dict
+        cfg = {k: v for k, v in self.algo_config.to_dict().items()
+               if not callable(v)}
         return {
             "learner_state": {
                 key: g.get_state() for key, g in self._all_learner_groups().items()
             },
-            "config": self.algo_config.to_dict(),
+            "config": cfg,
         }
 
     def load_checkpoint(self, checkpoint) -> None:

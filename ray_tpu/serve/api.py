@@ -46,6 +46,9 @@ def run(target: Deployment, *, blocking: bool = False,
     import ray_tpu
 
     controller = start()
+    # deploy nested bound deployments (the application graph) bottom-up,
+    # replacing each with a handle marker the replica resolves
+    target = _deploy_dependencies(controller, target)
     ray_tpu.get(controller.deploy.remote(target), timeout=60)
     if http and "proxy" not in _local:
         from ray_tpu.serve.http_proxy import HTTPProxy
@@ -60,6 +63,35 @@ def run(target: Deployment, *, blocking: bool = False,
         while True:
             time.sleep(3600)
     return handle
+
+
+def _deploy_dependencies(controller, target: Deployment,
+                         _deployed: Optional[set] = None) -> Deployment:
+    """Walk target's bound args; deploy nested Deployments (recursively,
+    dependencies first) and substitute DeploymentBoundArg markers."""
+    import ray_tpu
+
+    from ray_tpu.serve.deployment import DeploymentBoundArg
+
+    deployed = set() if _deployed is None else _deployed
+
+    def sub(v):
+        if isinstance(v, Deployment):
+            if v.name not in deployed:
+                deployed.add(v.name)
+                resolved = _deploy_dependencies(controller, v, deployed)
+                ray_tpu.get(controller.deploy.remote(resolved), timeout=60)
+            return DeploymentBoundArg(v.name)
+        if isinstance(v, (list, tuple)):
+            return type(v)(sub(e) for e in v)
+        if isinstance(v, dict):
+            return {k: sub(e) for k, e in v.items()}
+        return v
+
+    return target.options(
+        init_args=tuple(sub(a) for a in target.init_args),
+        init_kwargs={k: sub(v) for k, v in target.init_kwargs.items()},
+    )
 
 
 def get_handle(deployment_name: str):

@@ -28,6 +28,16 @@ class _ReplicaSet:
         self.actors: List[Any] = []          # ActorHandles
         self.target: int = 0
         self.last_scale_change: float = 0.0
+        # actor id → creation time: brand-new replicas get a startup grace
+        # before health checks count (replica init may be slow — imports,
+        # composition handle resolution — especially on loaded hosts)
+        self.born: Dict[int, float] = {}
+
+
+# a replica that hasn't answered a health check within this window of its
+# creation is declared unhealthy (reference: deployment_state's slow-start
+# grace before replica health checking kicks in)
+REPLICA_STARTUP_GRACE_S = 60.0
 
 
 class ServeController:
@@ -36,6 +46,12 @@ class ServeController:
         self._replicas: Dict[str, _ReplicaSet] = {}
         self._version = 0
         self._lock = threading.Lock()
+        # serializes whole reconcile passes: deploy() calls _reconcile from
+        # handler threads while the ticker thread runs it too — without
+        # mutual exclusion both see len(actors) < target during the (slow,
+        # blocking) health probes and double-create replicas, leaking CPU
+        # until fresh replicas sit PENDING forever
+        self._reconcile_mutex = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
@@ -108,6 +124,10 @@ class ServeController:
     def _reconcile(self):
         import ray_tpu
 
+        with self._reconcile_mutex:
+            self._reconcile_locked(ray_tpu)
+
+    def _reconcile_locked(self, ray_tpu):
         with self._lock:
             items = list(self._deployments.items())
         changed = False
@@ -115,20 +135,40 @@ class ServeController:
             rs = self._replicas.get(name)
             if rs is None:
                 continue
-            # drop dead replicas (replaced next tick)
+            # drop dead replicas (replaced next tick). Two subtleties:
+            # - a timeout is only "dead" after the startup grace: a replica
+            #   still constructing (slow imports, composition handle
+            #   resolution) queues the health probe behind __init__;
+            # - an unhealthy replica must be KILLED, not just dropped — a
+            #   wedged-but-alive process would keep its CPU forever and
+            #   starve every replacement into PENDING.
             alive = []
+            now = time.monotonic()
             for a in rs.actors:
+                born = rs.born.setdefault(id(a), now)
                 try:
                     ray_tpu.get(a.check_health.remote(), timeout=10)
                     alive.append(a)
+                except ray_tpu.exceptions.GetTimeoutError:
+                    if now - born < REPLICA_STARTUP_GRACE_S:
+                        alive.append(a)  # probably still starting up
+                    else:
+                        self._stop_replicas([a])
+                        rs.born.pop(id(a), None)
+                        changed = True
                 except Exception:  # noqa: BLE001 - replica died
+                    self._stop_replicas([a])
+                    rs.born.pop(id(a), None)
                     changed = True
             rs.actors = alive
             while len(rs.actors) < rs.target:
-                rs.actors.append(self._start_replica(dep))
+                new = self._start_replica(dep)
+                rs.born[id(new)] = time.monotonic()
+                rs.actors.append(new)
                 changed = True
             while len(rs.actors) > rs.target:
                 extra = rs.actors.pop()
+                rs.born.pop(id(extra), None)
                 self._stop_replicas([extra])
                 changed = True
         if changed:

@@ -37,12 +37,27 @@ class Deployment:
         return replace(self, **kwargs)
 
     def bind(self, *args, **kwargs) -> "Deployment":
-        """Fix constructor args (the reference's deployment-graph bind)."""
+        """Fix constructor args (the reference's application/graph bind).
+
+        Args may include OTHER bound Deployments — serve.run deploys those
+        dependencies first and the replica receives live DeploymentHandles
+        in their place, which is how multi-deployment applications compose
+        (the reference's model-composition pattern:
+        serve.run(Ingress.bind(model=Model.bind()))).
+        """
         return replace(self, init_args=args, init_kwargs=kwargs)
 
     @property
     def route(self) -> str:
         return self.route_prefix or f"/{self.name}"
+
+
+@dataclass(frozen=True)
+class DeploymentBoundArg:
+    """Marker left in init args where a nested bound Deployment sat; the
+    replica resolves it to a DeploymentHandle at construction time."""
+
+    name: str
 
 
 def deployment(

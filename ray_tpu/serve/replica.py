@@ -22,8 +22,27 @@ STREAM_IDLE_TIMEOUT_S = 300.0
 MAX_STREAMS = 1024
 
 
+def _resolve_bound(v):
+    """Swap DeploymentBoundArg markers (nested Deployment.bind args) for
+    live DeploymentHandles — resolvable from any cluster process because
+    the Serve controller is a named detached actor."""
+    from ray_tpu.serve.deployment import DeploymentBoundArg
+
+    if isinstance(v, DeploymentBoundArg):
+        from ray_tpu.serve import api
+
+        return api.get_handle(v.name)
+    if isinstance(v, (list, tuple)):
+        return type(v)(_resolve_bound(e) for e in v)
+    if isinstance(v, dict):
+        return {k: _resolve_bound(e) for k, e in v.items()}
+    return v
+
+
 class ServeReplica:
     def __init__(self, func_or_class, init_args, init_kwargs):
+        init_args = tuple(_resolve_bound(a) for a in init_args)
+        init_kwargs = {k: _resolve_bound(v) for k, v in init_kwargs.items()}
         if inspect.isclass(func_or_class):
             self._callable = func_or_class(*init_args, **init_kwargs)
         else:

@@ -1,3 +1,4 @@
+from ray_tpu.train.batch_predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -22,6 +23,9 @@ from ray_tpu.train.trainer import (
 )
 
 __all__ = [
+    "BatchPredictor",
+    "JaxPredictor",
+    "Predictor",
     "Checkpoint",
     "CheckpointConfig",
     "FailureConfig",

@@ -142,10 +142,13 @@ class HyperBandScheduler(ASHAScheduler):
         brackets: Optional[int] = None,
     ):
         if brackets is None:
-            # ladders remain non-trivial while grace*rf^b < max_t
-            brackets = max(
-                1, int(math.log(max_t / grace_period) / math.log(reduction_factor))
-            )
+            # ladders remain non-trivial while grace*rf^b < max_t (integer
+            # loop: float log misses exact powers and drops the last bracket)
+            brackets, g = 0, grace_period
+            while g < max_t:
+                brackets += 1
+                g *= reduction_factor
+            brackets = max(1, brackets)
         super().__init__(
             time_attr=time_attr, max_t=max_t, grace_period=grace_period,
             reduction_factor=reduction_factor, brackets=brackets,

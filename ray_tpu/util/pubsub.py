@@ -1,0 +1,133 @@
+"""General-purpose cluster pubsub over the GCS connection.
+
+Parity: the reference's pubsub plane (src/ray/pubsub/publisher.h:307 +
+python/ray/_private/gcs_pubsub.py) exposed as a small user API. Every
+process already holds a bidirectional GCS connection (core/rpc.py), so
+publishing is one RPC and subscriptions ride the existing server-push
+path — no polling, no extra daemon.
+
+    from ray_tpu.util.pubsub import publish, Subscriber
+
+    sub = Subscriber(["alerts"])          # any process
+    publish("alerts", {"sev": 1})         # any other process
+    channel, msg = sub.get_message(timeout=5)
+
+Channels here are namespaced "user:*" on the wire so they can never
+collide with the framework's internal channels (worker logs, actor state).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Any, List, Optional, Tuple
+
+_PREFIX = "user:"
+
+
+def _core():
+    import ray_tpu
+    from ray_tpu.api import _global_worker
+
+    if not ray_tpu.is_initialized():
+        raise RuntimeError("ray_tpu.init() first")
+    core = getattr(_global_worker().backend, "core", None)
+    if core is None:
+        raise RuntimeError(
+            "pubsub needs a cluster-backed runtime (local_mode has no GCS)"
+        )
+    return core
+
+
+def publish(channel: str, message: Any) -> int:
+    """Publish to `channel`; returns the number of current subscribers."""
+    core = _core()
+    return core.io.run(
+        core.gcs.call("publish", channel=_PREFIX + channel, payload=message,
+                      timeout=30),
+        timeout=35,
+    )
+
+
+# Per-process fanout: ONE push handler per channel on the shared GCS
+# connection dispatches to every live Subscriber's queue. Without this,
+# a second Subscriber on the same channel would hijack delivery (one
+# handler slot per channel per Connection) and either close() would
+# unsubscribe the survivor.
+_fanout: dict = {}          # wire channel -> set of queues
+_fanout_lock = __import__("threading").Lock()
+
+
+def _attach(core, wire_channel: str, q: "queue.Queue") -> bool:
+    """Register q; returns True if this is the channel's FIRST subscriber
+    in this process (the caller must then subscribe on the wire)."""
+    with _fanout_lock:
+        qs = _fanout.setdefault(wire_channel, set())
+        first = not qs
+        qs.add(q)
+        if first:
+            def dispatch(payload, ch=wire_channel):
+                with _fanout_lock:
+                    targets = list(_fanout.get(ch, ()))
+                for t in targets:
+                    t.put((ch[len(_PREFIX):], payload))
+            core.gcs.on_push(wire_channel, dispatch)
+        return first
+
+
+def _detach(core, wire_channel: str, q: "queue.Queue") -> bool:
+    """Unregister q; returns True if it was the channel's LAST subscriber
+    (the caller must then unsubscribe on the wire)."""
+    with _fanout_lock:
+        qs = _fanout.get(wire_channel, set())
+        qs.discard(q)
+        if qs:
+            return False
+        _fanout.pop(wire_channel, None)
+        core.gcs._push_handlers.pop(wire_channel, None)
+        return True
+
+
+class Subscriber:
+    """Receives messages on the given channels until close().
+
+    Messages are delivered to an internal queue by the GCS push path;
+    `get_message` blocks up to `timeout` and returns (channel, message) or
+    None on timeout. Multiple Subscribers per channel per process each get
+    every message (fan-out on the shared connection).
+    """
+
+    def __init__(self, channels: List[str]):
+        self._core = _core()
+        self._channels = [_PREFIX + c for c in channels]
+        self._q: "queue.Queue[Tuple[str, Any]]" = queue.Queue()
+        self._closed = False
+        fresh = [ch for ch in self._channels
+                 if _attach(self._core, ch, self._q)]
+        if fresh:
+            self._core.io.run(
+                self._core.gcs.call("subscribe", channels=fresh, timeout=30),
+                timeout=35,
+            )
+
+    def get_message(self, timeout: Optional[float] = None
+                    ) -> Optional[Tuple[str, Any]]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        last = [ch for ch in self._channels
+                if _detach(self._core, ch, self._q)]
+        if not last:
+            return
+        try:
+            self._core.io.run(
+                self._core.gcs.call("unsubscribe", channels=last, timeout=10),
+                timeout=15,
+            )
+        except Exception:  # noqa: BLE001 - shutdown-time best effort
+            pass

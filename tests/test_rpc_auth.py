@@ -101,3 +101,41 @@ def test_cross_process_driver_joins_via_token_file(cluster):
         text=True, timeout=120,
     )
     assert "JOINED 42" in out.stdout, out.stdout + out.stderr
+
+
+def test_protocol_version_mismatch_rejected():
+    """A peer speaking a different wire-protocol rev is closed at the
+    handshake with a logged reason — never unpickled (core/rpc.py
+    PROTOCOL_VERSION gate)."""
+    import asyncio
+    import pickle
+
+    from ray_tpu.core import rpc
+
+    class H:
+        def handle_ping(self, conn):
+            return "pong"
+
+    async def run():
+        server = rpc.RpcServer(H(), host="127.0.0.1", port=0)
+        await server.start()
+        host, port = server.address.rsplit(":", 1)
+
+        # wrong-rev preamble: connection must close without dispatch
+        reader, writer = await asyncio.open_connection(host, int(port))
+        bad = b"RAYTPU-AUTH999 " + (rpc.get_auth_token() or "").encode()
+        writer.write(len(bad).to_bytes(8, "little") + bad)
+        req = pickle.dumps((rpc.REQUEST, 1, "ping", {}))
+        writer.write(len(req).to_bytes(8, "little") + req)
+        await writer.drain()
+        got = await reader.read(1)  # server closes -> EOF
+        assert got == b""
+        writer.close()
+
+        # correct rev still works end-to-end
+        conn = await rpc.connect(f"{host}:{port}")
+        assert await conn.call("ping", timeout=10) == "pong"
+        await conn.close()
+        await server.close()
+
+    asyncio.run(run())

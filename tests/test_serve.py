@@ -397,3 +397,58 @@ def test_abandoned_stream_reaped():
         got.append(c["value"])
     assert got == list(range(5))
     assert r._streams == {}
+
+
+def test_deployment_composition_graph(serve_cluster):
+    """Application graph via nested bind (parity: serve model composition /
+    deployment graphs): serve.run(Ingress.bind(pre=Preprocess.bind(),
+    models=[A.bind(), B.bind()])) deploys the dependencies bottom-up and
+    the ingress replica receives live handles — a diamond DAG per request."""
+    ray, serve = serve_cluster
+
+    @serve.deployment(name="pre")
+    class Preprocess:
+        def __call__(self, text):
+            return text.strip().lower()
+
+    @serve.deployment(name="model_a")
+    class ModelA:
+        def __call__(self, text):
+            return {"a_len": len(text)}
+
+    @serve.deployment(name="model_b")
+    class ModelB:
+        def __call__(self, text):
+            return {"b_words": len(text.split())}
+
+    @serve.deployment(name="ingress")
+    class Ingress:
+        def __init__(self, pre, models):
+            self.pre = pre            # DeploymentHandle, resolved in-replica
+            self.models = models      # list of handles
+
+        def __call__(self, text):
+            import ray_tpu
+
+            clean = ray_tpu.get(self.pre.remote(text), timeout=30)
+            outs = ray_tpu.get(
+                [m.remote(clean) for m in self.models], timeout=30
+            )
+            merged = {}
+            for o in outs:
+                merged.update(o)
+            merged["clean"] = clean
+            return merged
+
+    app = Ingress.bind(
+        pre=Preprocess.bind(), models=[ModelA.bind(), ModelB.bind()]
+    )
+    handle = serve.run(app)
+    out = ray.get(handle.remote("  Hello Composed WORLD  "), timeout=60)
+    assert out == {"a_len": 20, "b_words": 3, "clean": "hello composed world"}
+
+    # dependencies are real deployments: individually addressable
+    pre_handle = serve.get_handle("pre")
+    assert ray.get(pre_handle.remote("  X "), timeout=30) == "x"
+    for name in ("ingress", "model_a", "model_b", "pre"):
+        serve.delete(name)

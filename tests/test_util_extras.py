@@ -73,3 +73,60 @@ def test_multiprocessing_pool(cluster):
         assert sorted(p.imap_unordered(sq, range(4))) == [0, 1, 4, 9]
         r = p.map_async(sq, [3, 4])
         assert r.get(timeout=60) == [9, 16]
+
+
+def test_pubsub_cross_process(ray_start_regular):
+    """General pubsub (util/pubsub.py over the GCS push path): a driver
+    subscriber receives messages published from REMOTE worker processes,
+    in order, with no polling; unsubscribed channels stay silent."""
+    import ray_tpu
+    from ray_tpu.util.pubsub import Subscriber, publish
+
+    sub = Subscriber(["alerts", "metrics"])
+
+    @ray_tpu.remote
+    def announce(i):
+        from ray_tpu.util.pubsub import publish as pub
+
+        n = pub("alerts", {"i": i})
+        pub("other", {"i": i})  # nobody listens to this one
+        return n
+
+    counts = ray_tpu.get([announce.remote(i) for i in range(3)], timeout=60)
+    assert all(c >= 1 for c in counts)  # the driver subscriber was counted
+
+    got = []
+    for _ in range(3):
+        msg = sub.get_message(timeout=30)
+        assert msg is not None
+        got.append(msg)
+    assert {ch for ch, _ in got} == {"alerts"}
+    assert sorted(m["i"] for _, m in got) == [0, 1, 2]
+    assert sub.get_message(timeout=0.5) is None  # "other" never delivered
+
+    publish("metrics", {"v": 7})
+    ch, m = sub.get_message(timeout=30)
+    assert (ch, m) == ("metrics", {"v": 7})
+
+    sub.close()
+    publish("alerts", {"late": True})
+    assert sub.get_message(timeout=1.0) is None  # closed: no delivery
+
+
+def test_pubsub_multiple_subscribers_one_process(ray_start_regular):
+    """Two Subscribers on one channel in the same process BOTH receive
+    every message; closing one must not break the survivor (per-process
+    fan-out over the single shared GCS connection)."""
+    from ray_tpu.util.pubsub import Subscriber, publish
+
+    s1 = Subscriber(["fan"])
+    s2 = Subscriber(["fan"])
+    publish("fan", 1)
+    assert s1.get_message(timeout=20) == ("fan", 1)
+    assert s2.get_message(timeout=20) == ("fan", 1)
+
+    s1.close()
+    publish("fan", 2)
+    assert s2.get_message(timeout=20) == ("fan", 2)  # survivor still live
+    assert s1.get_message(timeout=0.5) is None
+    s2.close()
