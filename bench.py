@@ -168,6 +168,27 @@ def main():
     float(m["loss"])
     dt = time.perf_counter() - t0
 
+    # Honest labels (ADVICE r5): the headline number is the SCANNED device
+    # loop (multi_step_fn: lax.scan over pre-staged batches — one dispatch
+    # for all N steps, the delivery data/iterator.iter_stacked_batches
+    # feeds). Per-step dispatch (one jitted call per optimizer step, what a
+    # host-driven JaxTrainer loop pays) is measured separately below.
+    ps_steps = 10
+    ps_batch = jax.device_put(
+        synthetic_batch(cfg, global_batch=global_batch, seed=7),
+        bundle.data_sharding,
+    )
+    state, pm = bundle.step_fn(state, ps_batch)  # warm per-step dispatch
+    float(pm["loss"])
+    t0 = time.perf_counter()
+    for _ in range(ps_steps):
+        state, pm = bundle.step_fn(state, ps_batch)
+    float(pm["loss"])
+    dt_ps = time.perf_counter() - t0
+    tps_chip_per_step = (
+        ps_steps * global_batch * cfg.seq_len / dt_ps / max(n_chips, 1)
+    )
+
     tokens = steps * global_batch * cfg.seq_len
     tps_chip = tokens / dt / max(n_chips, 1)
     mfu = None
@@ -197,11 +218,18 @@ def main():
         "value": round(tps_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 3),
+        # the headline is the scanned device loop; the per-step dispatch
+        # path is reported under its own label, not blended in
+        "schedule": "scanned_multi_step",
+        "per_step_dispatch_tokens_per_sec_per_chip": round(tps_chip_per_step, 1),
+        "scan_vs_per_step": round(tps_chip / max(tps_chip_per_step, 1e-9), 3),
     }
     # extra context on stderr (driver reads stdout's single JSON line)
     print(
         f"batch={global_batch} steps={steps} dt={dt:.2f}s "
-        f"loss={float(m['loss']):.3f} mfu={mfu if mfu is None else round(mfu, 3)}",
+        f"loss={float(m['loss']):.3f} mfu={mfu if mfu is None else round(mfu, 3)} "
+        f"| scanned={tps_chip:,.0f} tok/s/chip vs per-step dispatch="
+        f"{tps_chip_per_step:,.0f} tok/s/chip",
         file=sys.stderr,
     )
     print(json.dumps(result))

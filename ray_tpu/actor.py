@@ -13,6 +13,11 @@ from typing import Any, Optional
 from ray_tpu.core.ids import ActorID
 from ray_tpu.core.options import RemoteOptions
 
+# Well-known method name executed as fn(actor_instance, *args) by both
+# backends (local_backend.submit_actor_task, worker_main._execute_actor_task)
+# instead of an attribute lookup on the instance.
+CGRAPH_CALL_METHOD = "__ray_tpu_call__"
+
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
@@ -30,6 +35,13 @@ class ActorMethod:
         m = ActorMethod(self._handle, self._method_name, self._num_returns)
         m._call_options = kwargs
         return m
+
+    def bind(self, *args, **kwargs):
+        """DAG composition from a live handle (reference: actor_method.bind);
+        the resulting ClassMethodNode executes against THIS actor."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name).bind(*args, **kwargs)
 
     def remote(self, *args, **kwargs):
         from ray_tpu.api import _global_worker
@@ -88,6 +100,13 @@ class ActorHandle:
 
     def _actor_method_call(self, name, args, kwargs):
         return ActorMethod(self, name).remote(*args, **kwargs)
+
+    def _call_with_instance(self, fn, *args):
+        """Run ``fn(actor_instance, *args)`` inside the actor process via the
+        generic ``__ray_tpu_call__`` entry point (reference: ray's
+        ``__ray_call__``). Compiled graphs use this to install their
+        long-lived execution loops on user actors."""
+        return ActorMethod(self, CGRAPH_CALL_METHOD).remote(fn, *args)
 
 
 def _rebuild_handle(actor_id, options, method_num_returns=None):

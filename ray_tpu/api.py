@@ -109,6 +109,17 @@ def init(
 
 
 def shutdown():
+    import sys
+
+    # compiled graphs first: their execution loops block inside channel
+    # reads on actor threads — closing the channels releases those threads
+    # before the backend tears the actors down (only if cgraph was imported)
+    cgraph_mod = sys.modules.get("ray_tpu.cgraph.compiled_dag")
+    if cgraph_mod is not None and _worker.backend is not None:
+        try:
+            cgraph_mod.teardown_all()
+        except Exception:  # noqa: BLE001 - best-effort
+            pass
     with _init_lock:
         if _worker.backend is not None:
             try:

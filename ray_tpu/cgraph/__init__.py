@@ -1,0 +1,51 @@
+"""Compiled execution graphs: static DAG plans over pre-allocated channels.
+
+Parity: Ray's Compiled Graphs / accelerated-DAG subsystem
+(python/ray/dag/compiled_dag_node.py + experimental/channel/) — the mechanism
+vLLM uses for pipeline parallelism. The interpreted `DAGNode.execute()` path
+re-submits tasks and round-trips an ObjectRef per edge on every call;
+`dag.experimental_compile()` instead walks the graph ONCE, pre-allocates
+typed channels between the participating actors (shared-memory ring buffers
+for cross-process edges, in-process buffers for local edges), and installs a
+long-lived execution loop on each actor. Repeated `compiled.execute(x)`
+calls then push inputs into channels and await the output channel — no
+per-call task submission, no control-plane round trips, and up to
+`max_in_flight` overlapped executions pipelined through the graph.
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    a, b = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        dag = b.step.bind(a.step.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(x) for x in batches]   # overlapped
+        outs = [r.get() for r in refs]
+    finally:
+        compiled.teardown()
+"""
+
+from ray_tpu.cgraph.channel import (
+    ChannelClosedError,
+    ChannelTimeoutError,
+    IntraProcessChannel,
+    ShmChannel,
+)
+from ray_tpu.cgraph.compiled_dag import (
+    CompiledDAG,
+    CompiledDAGRef,
+    actor_in_compiled_graph,
+    compile_dag,
+)
+
+__all__ = [
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "compile_dag",
+    "actor_in_compiled_graph",
+    "ChannelClosedError",
+    "ChannelTimeoutError",
+    "IntraProcessChannel",
+    "ShmChannel",
+]

@@ -1,0 +1,272 @@
+"""Typed channels for compiled graphs.
+
+Two implementations behind one blocking read/write interface:
+
+- ``ShmChannel``: a single-producer single-consumer ring buffer over an
+  mmap'd tmpfs file in the session's shm directory (the same directory
+  ``core/object_store/shm_store.py`` uses), for edges that cross process
+  boundaries on one host. The driver creates the file at compile time; the
+  actor-side loop attaches by path when the channel is unpickled, so the
+  data path after compile is mmap write → mmap read with zero daemon or RPC
+  involvement. Parity: Ray's experimental mutable-plasma channels
+  (experimental/channel/shared_memory_channel.py), with the plasma arena
+  replaced by one file per channel.
+- ``IntraProcessChannel``: a condition-variable deque for edges whose
+  endpoints share a process (local_mode actors are threads), passed by
+  reference through the local backend.
+
+Both bound the number of undelivered messages (``max_msgs``) — that bound is
+what limits how many executions can be in flight through a compiled graph —
+and both turn ``close()`` into ``ChannelClosedError`` at every blocked or
+future reader/writer, which is how teardown and driver death unstick the
+actor-side loops.
+
+Messages are arbitrary picklables; the SPSC discipline means publication
+order (payload bytes before the write-position bump) is the only memory
+ordering the ring needs.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ray_tpu import exceptions as exc
+
+# header layout (one 64-byte block at the file start)
+_OFF_CAP = 0       # u64 data capacity in bytes
+_OFF_MAXMSG = 8    # u64 max undelivered messages
+_OFF_WPOS = 16     # u64 monotonically increasing write offset
+_OFF_RPOS = 24     # u64 monotonically increasing read offset
+_OFF_WSEQ = 32     # u64 messages written
+_OFF_RSEQ = 40     # u64 messages read
+_OFF_CLOSED = 48   # u8  closed flag (either side)
+_HDR = 64
+_SKIP = 0xFFFFFFFF  # length sentinel: rest of the ring is padding, wrap
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+
+
+class ChannelClosedError(exc.RayTpuError):
+    """The channel was closed (teardown or peer death) while blocked on it."""
+
+
+class ChannelTimeoutError(exc.GetTimeoutError):
+    """A channel read/write did not complete within the timeout."""
+
+
+def _dumps(obj: Any) -> bytes:
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except Exception:  # noqa: BLE001 - closures, local classes
+        import cloudpickle
+
+        return cloudpickle.dumps(obj)
+
+
+class _Backoff:
+    """Spin briefly, then sleep with a growing (capped) interval: the first
+    messages of a hot pipeline stay in the sub-µs spin window while an idle
+    channel costs ~1 ms of wakeups per second."""
+
+    def __init__(self):
+        self._spins = 0
+
+    def pause(self):
+        self._spins += 1
+        if self._spins < 200:
+            return
+        time.sleep(min(0.002, 0.00005 * (self._spins - 199)))
+
+
+class ShmChannel:
+    """SPSC byte-ring over an mmap'd file; blocking write/read of pickled
+    messages. One writer process and one reader process at a time."""
+
+    def __init__(self, path: str, capacity: int = 1 << 20, max_msgs: int = 16,
+                 create: bool = False):
+        self.path = path
+        if create:
+            with open(path, "w+b") as f:
+                f.truncate(_HDR + capacity)
+            self._open()
+            _U64.pack_into(self._mm, _OFF_CAP, capacity)
+            _U64.pack_into(self._mm, _OFF_MAXMSG, max_msgs)
+        else:
+            self._open()
+        self.capacity = _U64.unpack_from(self._mm, _OFF_CAP)[0]
+        self.max_msgs = _U64.unpack_from(self._mm, _OFF_MAXMSG)[0]
+
+    def _open(self):
+        self._f = open(self.path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(),
+                             os.fstat(self._f.fileno()).st_size)
+
+    def __reduce__(self):
+        return (ShmChannel, (self.path,))
+
+    # ------------------------------------------------------------- helpers
+    def _u64(self, off: int) -> int:
+        return _U64.unpack_from(self._mm, off)[0]
+
+    @property
+    def closed(self) -> bool:
+        return self._mm[_OFF_CLOSED] != 0
+
+    def _check_deadline(self, deadline: Optional[float], what: str):
+        if self.closed:
+            raise ChannelClosedError(f"channel {os.path.basename(self.path)} closed")
+        if deadline is not None and time.monotonic() > deadline:
+            raise ChannelTimeoutError(f"channel {what} timed out")
+
+    # ------------------------------------------------------------ write/read
+    def write(self, obj: Any, timeout: Optional[float] = None) -> None:
+        data = _dumps(obj)
+        need = 4 + len(data)
+        # A wrapped write consumes the contiguous tail AND the message, so a
+        # message over half the ring may need contig+need > capacity at an
+        # unlucky offset — space that can never free up. Capping at half the
+        # ring keeps every admitted message writable at every offset.
+        if need > self.capacity // 2:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds the channel's max "
+                f"message size ({self.capacity // 2 - 4} bytes = half its "
+                "ring); compile with a larger buffer_size_bytes"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        cap = self.capacity
+        backoff = _Backoff()
+        while True:
+            self._check_deadline(deadline, "write")
+            wpos = self._u64(_OFF_WPOS)
+            rpos = self._u64(_OFF_RPOS)
+            if self._u64(_OFF_WSEQ) - self._u64(_OFF_RSEQ) >= self.max_msgs:
+                backoff.pause()
+                continue
+            off = wpos % cap
+            contig = cap - off
+            total = need if contig >= need else contig + need
+            if cap - (wpos - rpos) < total:
+                backoff.pause()
+                continue
+            if contig < need:
+                if contig >= 4:
+                    _U32.pack_into(self._mm, _HDR + off, _SKIP)
+                wpos += contig
+                off = 0
+            _U32.pack_into(self._mm, _HDR + off, len(data))
+            self._mm[_HDR + off + 4:_HDR + off + 4 + len(data)] = data
+            # publish: payload is in place before the positions move
+            _U64.pack_into(self._mm, _OFF_WPOS, wpos + need)
+            _U64.pack_into(self._mm, _OFF_WSEQ, self._u64(_OFF_WSEQ) + 1)
+            return
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        cap = self.capacity
+        backoff = _Backoff()
+        while True:
+            rpos = self._u64(_OFF_RPOS)
+            wpos = self._u64(_OFF_WPOS)
+            if rpos == wpos:
+                # closed is only honored on an EMPTY ring: messages written
+                # before close() (e.g. a final error) must still deliver
+                self._check_deadline(deadline, "read")
+                backoff.pause()
+                continue
+            off = rpos % cap
+            contig = cap - off
+            if contig < 4:
+                _U64.pack_into(self._mm, _OFF_RPOS, rpos + contig)
+                continue
+            ln = _U32.unpack_from(self._mm, _HDR + off)[0]
+            if ln == _SKIP:
+                _U64.pack_into(self._mm, _OFF_RPOS, rpos + contig)
+                continue
+            data = bytes(self._mm[_HDR + off + 4:_HDR + off + 4 + ln])
+            _U64.pack_into(self._mm, _OFF_RPOS, rpos + 4 + ln)
+            _U64.pack_into(self._mm, _OFF_RSEQ, self._u64(_OFF_RSEQ) + 1)
+            return pickle.loads(data)
+
+    # ------------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        try:
+            self._mm[_OFF_CLOSED] = 1
+        except (ValueError, OSError):
+            pass  # already unmapped
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            self._mm.close()
+            self._f.close()
+        except (BufferError, OSError):
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class IntraProcessChannel:
+    """Bounded in-process channel (local_mode edges; endpoints share the
+    interpreter so messages pass by reference, no serialization)."""
+
+    def __init__(self, max_msgs: int = 16):
+        self.max_msgs = max_msgs
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __reduce__(self):
+        raise TypeError(
+            "IntraProcessChannel cannot cross a process boundary; compiled "
+            "graphs allocate ShmChannels for cross-process edges"
+        )
+
+    def write(self, obj: Any, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while len(self._q) >= self.max_msgs:
+                if self._closed:
+                    raise ChannelClosedError("channel closed")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ChannelTimeoutError("channel write timed out")
+                self._cond.wait(timeout=remaining if remaining is None else min(remaining, 0.2))
+            if self._closed:
+                raise ChannelClosedError("channel closed")
+            self._q.append(obj)
+            self._cond.notify_all()
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    raise ChannelClosedError("channel closed")
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ChannelTimeoutError("channel read timed out")
+                self._cond.wait(timeout=remaining if remaining is None else min(remaining, 0.2))
+            obj = self._q.popleft()
+            self._cond.notify_all()
+            return obj
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def unlink(self) -> None:
+        self.close()

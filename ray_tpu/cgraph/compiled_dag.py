@@ -1,0 +1,597 @@
+"""CompiledDAG: static execution plan + driver-side execute/get/teardown.
+
+``compile_dag(dag)`` walks a bound DAGNode graph once and freezes it:
+
+1. topo-sort the runtime nodes (FunctionNode / ClassMethodNode); resolve
+   every ClassNode to a live actor handle; give each FunctionNode a
+   dedicated executor actor (plain functions have no resident process);
+2. pre-allocate one channel per cross-loop edge — shared-memory ring
+   buffers (channel.ShmChannel) in cluster mode, in-process buffers in
+   local mode — plus driver→graph input channels and graph→driver output
+   channels; edges between nodes on the SAME actor stay loop-local (no
+   channel, no serialization);
+3. install one long-lived execution loop per participating actor via the
+   generic ``__ray_tpu_call__`` entry point (executor.node_loop).
+
+``execute(*args)`` then just pickles the input into the input rings and
+returns a ``CompiledDAGRef``; ``ref.get()`` awaits the output ring. No task
+submission, no ObjectRef round-trips per call, and up to ``max_in_flight``
+executions overlap per edge (microbatch pipelining — submitting past that
+bound blocks until results are consumed).
+
+Error semantics: an exception in any node is forwarded through the graph as
+an ("err", ...) message so the pipeline stays aligned, and re-raises at
+``ref.get()``. ``teardown()`` sends a stop sentinel, closes every channel
+(unblocking any stuck loop), joins the loops, and frees the rings.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.cgraph import executor as ex
+from ray_tpu.cgraph.channel import (
+    ChannelClosedError,
+    ChannelTimeoutError,
+    IntraProcessChannel,
+    ShmChannel,
+)
+from ray_tpu.dag import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_TICK = object()  # accessor marking a pacing-only input channel
+
+# live graphs, torn down by ray_tpu.shutdown(): execution loops block inside
+# channel reads on non-daemon actor threads, so leaked graphs would hang
+# interpreter exit
+_live_graphs: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def teardown_all(timeout: float = 5.0) -> None:
+    for g in list(_live_graphs):
+        try:
+            g.teardown(timeout=timeout)
+        except Exception:  # noqa: BLE001 - best-effort shutdown path
+            pass
+
+
+# actor ids currently hosting a compiled-graph loop: an actor's execution
+# loop occupies its (ordered) dispatch thread, so a second graph compiled
+# over the same actor would queue behind the first forever — fail fast with
+# a clear error instead (same restriction as Ray's compiled graphs).
+_actors_in_use: Dict[bytes, str] = {}
+_actors_in_use_lock = threading.Lock()
+
+
+def actor_in_compiled_graph(actor_handle) -> bool:
+    """True when the actor currently hosts a compiled-graph execution loop
+    (public query — e.g. serve picks an unpinned replica to compile)."""
+    with _actors_in_use_lock:
+        return actor_handle._actor_id.binary() in _actors_in_use
+
+
+class CompiledDAGRef:
+    """Result handle for one ``execute()`` call; ``get()`` blocks on the
+    output channel. The first successful get() moves the result out of the
+    driver's seq buffer onto this ref (so long-running pipelines don't
+    accumulate consumed results); repeat gets return the cached value."""
+
+    _UNSET = object()
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value = CompiledDAGRef._UNSET
+        self._error: Optional[BaseException] = None
+
+    def get(self, timeout: Optional[float] = None):
+        if self._error is not None:
+            raise self._error
+        if self._value is not CompiledDAGRef._UNSET:
+            return self._value
+        try:
+            self._value = self._dag._get_result(self._seq, timeout)
+        except ChannelTimeoutError:
+            raise  # retryable: the result is still in flight
+        except BaseException as e:
+            self._error = e
+            raise
+        return self._value
+
+    def __repr__(self):
+        return f"CompiledDAGRef(seq={self._seq})"
+
+
+class _Loop:
+    """Plan state for one participating actor."""
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.nodes: List[ex.ExecNode] = []
+        self.in_channels: List[Any] = []
+        self.in_index: Dict[Any, int] = {}   # edge key -> in_channels index
+        self.out_channels: List[Any] = []
+        self.ref = None                       # the loop task's ObjectRef
+
+    def in_channel(self, key, make_channel) -> int:
+        idx = self.in_index.get(key)
+        if idx is None:
+            ch = make_channel()
+            idx = len(self.in_channels)
+            self.in_channels.append(ch)
+            self.in_index[key] = idx
+        return idx
+
+    def add_out_channel(self, ch) -> int:
+        self.out_channels.append(ch)
+        return len(self.out_channels) - 1
+
+
+def compile_dag(dag: DAGNode, *, max_in_flight: int = 16,
+                buffer_size_bytes: int = 4 << 20) -> "CompiledDAG":
+    return CompiledDAG(dag, max_in_flight=max_in_flight,
+                       buffer_size_bytes=buffer_size_bytes)
+
+
+class CompiledDAG:
+    def __init__(self, dag: DAGNode, *, max_in_flight: int = 16,
+                 buffer_size_bytes: int = 4 << 20):
+        import ray_tpu  # noqa: F401 - ensures runtime init below
+        from ray_tpu.api import _auto_init, _global_worker
+
+        _auto_init()
+        backend = _global_worker().backend
+        if _global_worker().mode == "client":
+            raise NotImplementedError(
+                "experimental_compile is not supported over ray:// client "
+                "connections (channels need host shared memory)"
+            )
+        self._core = getattr(backend, "core", None)
+        self._graph_id = uuid.uuid4().hex[:12]
+        self.max_in_flight = max(1, max_in_flight)
+        self.buffer_size_bytes = buffer_size_bytes
+        # separate locks so teardown() (which only flips the flag before
+        # closing channels) can never deadlock behind an execute()/get()
+        # blocked inside a channel operation
+        self._exec_lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        self._flag_lock = threading.Lock()
+        self._torn_down = False
+        self._broken: Optional[str] = None
+        self._submitted = 0
+        self._next_result_seq = 0
+        self._results: Dict[int, List[Tuple[str, Any]]] = {}
+        # output messages already consumed for the in-progress seq: a get()
+        # timeout between output-channel reads must NOT drop them, or a
+        # retry would re-read channel 0 one seq ahead and misalign forever
+        self._partial_entry: List[Tuple[str, Any]] = []
+        self._channels: List[Any] = []
+        self._fn_actors: List[Any] = []
+        try:
+            self._compile(dag)
+        except BaseException:
+            self._torn_down = True  # skip loop joins in the cleanup
+            with _actors_in_use_lock:
+                for aid, gid in list(_actors_in_use.items()):
+                    if gid == self._graph_id:
+                        del _actors_in_use[aid]
+            for ch in self._channels:
+                try:
+                    ch.unlink()
+                except Exception:  # noqa: BLE001
+                    pass
+            import ray_tpu
+
+            for a in self._fn_actors:  # executor actors already spawned
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        _live_graphs.add(self)
+
+    # ----------------------------------------------------------- channels
+    def _make_channel(self):
+        if self._core is not None:
+            import os
+
+            from ray_tpu.core.object_store import shm_store
+
+            d = os.path.join(shm_store.session_dir(self._core.session),
+                             f"cgraph_{self._graph_id}")
+            os.makedirs(d, exist_ok=True)
+            ch = ShmChannel(
+                os.path.join(d, f"chan_{len(self._channels)}"),
+                capacity=self.buffer_size_bytes,
+                max_msgs=self.max_in_flight,
+                create=True,
+            )
+        else:
+            ch = IntraProcessChannel(max_msgs=self.max_in_flight)
+        self._channels.append(ch)
+        return ch
+
+    # ------------------------------------------------------------ compile
+    def _compile(self, dag: DAGNode):
+        outputs = dag.outputs if isinstance(dag, MultiOutputNode) else [dag]
+        for o in outputs:
+            if not isinstance(o, (FunctionNode, ClassMethodNode)):
+                raise ValueError(
+                    "compiled graph outputs must be bound function/method "
+                    f"nodes, got {type(o).__name__}"
+                )
+
+        # 1) collect runtime nodes in topo (DFS post-) order
+        order: List[DAGNode] = []
+        seen: Dict[int, bool] = {}   # id(node) -> fully visited
+        def visit(node):
+            if not isinstance(node, (FunctionNode, ClassMethodNode)):
+                return
+            state = seen.get(id(node))
+            if state is True:
+                return
+            if state is False:
+                raise ValueError("cycle detected in DAG")
+            seen[id(node)] = False
+            for dep in list(node._bound_args) + list(node._bound_kwargs.values()):
+                visit(dep)
+            seen[id(node)] = True
+            order.append(node)
+        for o in outputs:
+            visit(o)
+
+        keys = {id(n): i for i, n in enumerate(order)}
+        self._nodes = order  # keeps id()s alive for the maps below
+
+        # 2) executors: ClassMethodNodes run on their actor; FunctionNodes
+        # each get a dedicated executor actor (stage parallelism)
+        import ray_tpu
+        from ray_tpu.core.core_worker import _pickle_callable
+
+        handles: Dict[int, Any] = {}
+        for n in order:
+            if isinstance(n, ClassMethodNode):
+                handles[id(n)] = n.resolve_handle(None)
+            else:
+                # carry the remote function's placement-relevant options onto
+                # its executor actor (a TPU stage keeps its num_tpus etc.)
+                fopts = n._fn._default_options
+                kw: Dict[str, Any] = {
+                    k: getattr(fopts, k)
+                    for k in ("num_cpus", "num_tpus", "memory",
+                              "accelerator_type", "scheduling_strategy",
+                              "placement_group")
+                    if getattr(fopts, k) is not None
+                }
+                if fopts.resources:
+                    kw["resources"] = dict(fopts.resources)
+                kw.setdefault("num_cpus", 0)
+                actor_cls = ray_tpu.remote(**kw)(ex.FnExecutorActor)
+                a = actor_cls.remote()
+                self._fn_actors.append(a)
+                handles[id(n)] = a
+
+        loops: Dict[bytes, _Loop] = {}
+        loop_of: Dict[int, _Loop] = {}
+        for n in order:
+            h = handles[id(n)]
+            loop = loops.get(h._actor_id.binary())
+            if loop is None:
+                loop = loops[h._actor_id.binary()] = _Loop(h)
+            loop_of[id(n)] = loop
+        with _actors_in_use_lock:
+            for aid in loops:
+                if aid in _actors_in_use:
+                    raise ValueError(
+                        "actor already participates in compiled graph "
+                        f"{_actors_in_use[aid]}; an actor's execution loop "
+                        "occupies its dispatch thread, so it can host only "
+                        "one compiled graph at a time (teardown() the other "
+                        "graph first)"
+                    )
+            for aid in loops:
+                _actors_in_use[aid] = self._graph_id
+
+        # 3) wire edges: build each node's ExecNode with resolved arg sources
+        exec_nodes: Dict[int, ex.ExecNode] = {}
+
+        def source_for(dep, consumer_loop: _Loop) -> Tuple[str, Any]:
+            if isinstance(dep, (FunctionNode, ClassMethodNode)):
+                producer_loop = loop_of[id(dep)]
+                if producer_loop is consumer_loop:
+                    exec_nodes[id(dep)].keep_local = True
+                    return (ex.SRC_LOCAL, keys[id(dep)])
+                key = ("node", id(dep), id(consumer_loop))
+                idx = consumer_loop.in_channel(
+                    key, lambda: self._edge_channel(dep, producer_loop, key)
+                )
+                return (ex.SRC_CHAN, idx)
+            if isinstance(dep, (InputNode, InputAttributeNode)):
+                accessor = dep._key if isinstance(dep, InputAttributeNode) else None
+                key = ("input", id(dep), id(consumer_loop))
+                idx = consumer_loop.in_channel(
+                    key, lambda: self._input_channel(accessor)
+                )
+                return (ex.SRC_CHAN, idx)
+            if isinstance(dep, ClassNode):
+                return (ex.SRC_CONST, dep.execute(None))
+            if isinstance(dep, MultiOutputNode):
+                raise ValueError("MultiOutputNode can only be the graph root")
+            return (ex.SRC_CONST, dep)
+
+        # producer-side out-channel registry, filled by _edge_channel
+        self._pending_out: Dict[Any, Tuple[Any, Any]] = {}
+        self._input_channels: List[Tuple[Any, Any]] = []  # (accessor, chan)
+
+        for n in order:
+            loop = loop_of[id(n)]
+            if isinstance(n, ClassMethodNode):
+                en = ex.ExecNode(key=keys[id(n)], method_name=n._method_name,
+                                 fn_blob=None)
+            else:
+                en = ex.ExecNode(
+                    key=keys[id(n)], method_name=None,
+                    fn_blob=_pickle_callable(n._fn._function),
+                )
+            exec_nodes[id(n)] = en
+            loop.nodes.append(en)
+            en.args = [source_for(a, loop) for a in n._bound_args]
+            en.kwargs = {k: source_for(v, loop)
+                         for k, v in n._bound_kwargs.items()}
+
+        # register producer-side out-channel indexes (deferred because the
+        # producer's ExecNode may not exist yet when the edge is created)
+        for producer, ch in self._pending_out.values():
+            idx = loop_of[id(producer)].add_out_channel(ch)
+            exec_nodes[id(producer)].out_channels.append(idx)
+        del self._pending_out
+
+        # 4) output channels: one per unique output node, read by the driver
+        self._output_chan_of: Dict[int, int] = {}   # id(node) -> driver index
+        self._output_channels: List[Any] = []
+        self._output_positions: List[int] = []      # position -> driver index
+        for o in outputs:
+            didx = self._output_chan_of.get(id(o))
+            if didx is None:
+                ch = self._make_channel()
+                didx = len(self._output_channels)
+                self._output_channels.append(ch)
+                self._output_chan_of[id(o)] = didx
+                idx = loop_of[id(o)].add_out_channel(ch)
+                exec_nodes[id(o)].out_channels.append(idx)
+            self._output_positions.append(didx)
+        self._single_output = not isinstance(dag, MultiOutputNode)
+
+        # 5) every loop must be paced by at least one driver-fed channel,
+        # or a source loop would free-run ahead of execute() calls
+        for loop in loops.values():
+            if not loop.in_channels:
+                ch = self._input_channel(_TICK)
+                loop.in_channels.append(ch)
+
+        # 6) install the loops (one long-lived actor task each)
+        self._loops = list(loops.values())
+        for loop in self._loops:
+            loop.ref = loop.handle._call_with_instance(
+                ex.node_loop, loop.nodes, loop.in_channels, loop.out_channels
+            )
+
+    def _edge_channel(self, producer, producer_loop: _Loop, key):
+        ch = self._make_channel()
+        self._pending_out[key] = (producer, ch)
+        return ch
+
+    def _input_channel(self, accessor):
+        ch = self._make_channel()
+        self._input_channels.append((accessor, ch))
+        return ch
+
+    # ------------------------------------------------------------ execute
+    def _extract_input(self, accessor, args, kwargs):
+        if accessor is _TICK:
+            return None
+        if accessor is None:
+            if len(args) != 1 or kwargs:
+                raise TypeError(
+                    "this graph binds the whole InputNode; call "
+                    "execute(<one value>) (use inp[i]/inp['k'] bindings for "
+                    "multi-argument graphs)"
+                )
+            return args[0]
+        if isinstance(accessor, int):
+            return args[accessor]
+        return kwargs[accessor]
+
+    def execute(self, *args, timeout: Optional[float] = None, **kwargs):
+        """Push one input through the graph; returns a CompiledDAGRef.
+
+        Blocks (up to ``timeout``) when ``max_in_flight`` executions are
+        already buffered on an input edge — consuming results with
+        ``ref.get()`` frees the slots."""
+        with self._exec_lock:
+            self._check_usable()
+            if not self._input_channels:
+                raise RuntimeError("compiled graph has no input channels")
+            values = [
+                (ch, self._extract_input(accessor, args, kwargs))
+                for accessor, ch in self._input_channels
+            ]
+            import time as _time
+
+            deadline = None if timeout is None else _time.monotonic() + timeout
+            wrote = 0
+            try:
+                for ch, v in values:
+                    # bounded write slices with loop-death probes between
+                    # them (mirrors _get_result): a dead stage never closes
+                    # the ring, so a full input channel would otherwise
+                    # block a timeout=None execute forever
+                    while True:
+                        remaining = (
+                            None if deadline is None
+                            else deadline - _time.monotonic()
+                        )
+                        if remaining is not None and remaining <= 0:
+                            self._raise_if_loop_died()
+                            raise ChannelTimeoutError(
+                                "execute() input write timed out"
+                            )
+                        step = 5.0 if remaining is None else min(remaining, 5.0)
+                        try:
+                            ch.write((ex.VAL, v), timeout=step)
+                            break
+                        except ChannelTimeoutError:
+                            self._raise_if_loop_died()
+                    wrote += 1
+            except BaseException:
+                # not just timeouts: an oversized or unpicklable input can
+                # raise from write() too, and a partially-written seq would
+                # silently pair later inputs off-by-one
+                if 0 < wrote < len(values):
+                    self._broken = (
+                        "execute() failed after writing some input "
+                        "channels; the graph is misaligned — teardown()"
+                    )
+                raise
+            seq = self._submitted
+            self._submitted += 1
+            return CompiledDAGRef(self, seq)
+
+    def _check_usable(self):
+        if self._torn_down:
+            raise RuntimeError("compiled graph was torn down")
+        if self._broken:
+            raise RuntimeError(self._broken)
+
+    def _get_result(self, seq: int, timeout: Optional[float]):
+        import time as _time
+
+        with self._read_lock:
+            self._check_usable()
+            if seq >= self._submitted:
+                raise ValueError(f"seq {seq} was never submitted")
+            deadline = None if timeout is None else _time.monotonic() + timeout
+            while self._next_result_seq <= seq:
+                # read in bounded slices, probing the loops between slices:
+                # a dead actor never sets the channel's closed flag, so a
+                # plain timeout=None read would hang instead of surfacing
+                # the loop's death. Messages already read for this seq live
+                # in _partial_entry so a timeout + retry resumes where it
+                # left off instead of re-reading channel 0.
+                entry = self._partial_entry
+                while len(entry) < len(self._output_channels):
+                    ch = self._output_channels[len(entry)]
+                    remaining = (
+                        None if deadline is None
+                        else deadline - _time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        self._raise_if_loop_died()
+                        raise ChannelTimeoutError(
+                            f"result seq {seq} not ready within timeout"
+                        )
+                    step = 5.0 if remaining is None else min(remaining, 5.0)
+                    try:
+                        entry.append(ch.read(timeout=step))
+                    except ChannelTimeoutError:
+                        self._raise_if_loop_died()
+                self._results[self._next_result_seq] = entry
+                self._partial_entry = []
+                self._next_result_seq += 1
+            # moved onto the CompiledDAGRef by get(); keeping consumed
+            # entries here would leak for the lifetime of a hot pipeline
+            entry = self._results.pop(seq, None)
+            if entry is None:
+                raise RuntimeError(f"result for seq {seq} already consumed")
+        msgs = [entry[didx] for didx in self._output_positions]
+        for kind, payload in msgs:
+            if kind == ex.STOP:
+                # a teardown racing this get() flushed the stop sentinel
+                # into the output ring; it must not read as a None result
+                raise ChannelClosedError(
+                    "compiled graph torn down while awaiting this result"
+                )
+            if kind == ex.ERR:
+                raise payload.as_instanceof_cause()
+        if self._single_output:
+            return msgs[0][1]
+        return [payload for _, payload in msgs]
+
+    def _raise_if_loop_died(self):
+        """A get() timeout may really be a dead loop (actor died, loop
+        crashed): surface that error instead of the generic timeout."""
+        import ray_tpu
+
+        for loop in self._loops:
+            ready, _ = ray_tpu.wait([loop.ref], timeout=0)
+            if ready:
+                try:
+                    ray_tpu.get(loop.ref)
+                except BaseException as e:
+                    raise RuntimeError(
+                        "compiled graph execution loop died"
+                    ) from e
+                raise RuntimeError(
+                    "a compiled graph execution loop exited early "
+                    "(actor torn down?)"
+                )
+
+    # ----------------------------------------------------------- teardown
+    def teardown(self, timeout: float = 10.0):
+        """Stop the loops, free the channels. Idempotent."""
+        with self._flag_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+        # stop sentinel first (graceful: loops drain in seq order), then
+        # close every channel — closing is what unblocks a loop stuck on a
+        # full/empty ring, and pre-close messages still deliver, so the
+        # sentinel is not lost
+        for _, ch in getattr(self, "_input_channels", ()):
+            try:
+                ch.write((ex.STOP, None), timeout=0.5)
+            except Exception:  # noqa: BLE001 - full/closed: close handles it
+                pass
+        for ch in self._channels:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
+        import ray_tpu
+
+        for loop in getattr(self, "_loops", ()):
+            try:
+                ray_tpu.get(loop.ref, timeout=timeout)
+            except Exception:  # noqa: BLE001 - loop already gone
+                pass
+        for ch in self._channels:
+            try:
+                ch.unlink()
+            except Exception:  # noqa: BLE001
+                pass
+        for a in self._fn_actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self._fn_actors = []
+        with _actors_in_use_lock:
+            for aid, gid in list(_actors_in_use.items()):
+                if gid == self._graph_id:
+                    del _actors_in_use[aid]
+
+    def __del__(self):
+        try:
+            self.teardown(timeout=1.0)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass

@@ -1,0 +1,156 @@
+"""Actor-side execution loops for compiled graphs.
+
+``compile_dag`` ships one ``node_loop`` per participating actor through the
+generic ``__ray_tpu_call__`` actor entry point (actor.py / worker_main.py /
+local_backend.py): the loop runs as ONE long-lived actor task, reading every
+inbound channel once per iteration, executing that actor's nodes in topo
+order, and writing results downstream. Messages are tagged tuples:
+
+    ("val", value)   normal dataflow
+    ("err", error)   an upstream node raised; skip compute and forward, so
+                     the pipeline stays seq-aligned and the error surfaces
+                     at CompiledDAGRef.get() (Ray cgraph error semantics)
+    ("stop", None)   teardown sentinel; forwarded downstream, then the loop
+                     exits cleanly
+
+The loop also exits on ChannelClosedError (forced teardown / driver death).
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu.cgraph.channel import ChannelClosedError, ChannelTimeoutError
+
+# input-source encodings for ExecNode.args / .kwargs
+SRC_CHAN = "chan"      # ("chan", in_channel_index)
+SRC_LOCAL = "local"    # ("local", producer node key) — same-loop edge
+SRC_CONST = "const"    # ("const", value)
+
+VAL, ERR, STOP = "val", "err", "stop"
+
+
+@dataclass
+class ExecNode:
+    """One compiled node as executed inside an actor's loop."""
+
+    key: int                      # compile-time node id (diagnostics)
+    method_name: Optional[str]    # actor method to call, or None for fn nodes
+    fn_blob: Optional[bytes]      # cloudpickled callable for function nodes
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs: Dict[str, Tuple[str, Any]] = field(default_factory=dict)
+    out_channels: List[int] = field(default_factory=list)
+    keep_local: bool = False      # a same-loop consumer reads the result
+    _fn: Any = None               # unpickled callable cache
+
+    def resolve_callable(self, instance):
+        if self.method_name is not None:
+            return getattr(instance, self.method_name)
+        if self._fn is None:
+            self._fn = pickle.loads(self.fn_blob)
+        return self._fn
+
+
+class FnExecutorActor:
+    """Dedicated executor actor hosting compiled FunctionNodes (plain
+    ``@remote`` functions have no resident process of their own, so compile
+    gives each one a worker to pin its loop on)."""
+
+    def ping(self):
+        return True
+
+
+def node_loop(instance, nodes: List[ExecNode], in_channels: List[Any],
+              out_channels: List[Any]) -> int:
+    """Run this actor's compiled nodes until a stop sentinel or teardown.
+
+    Channel inputs are read LAZILY, at the node that consumes them (once
+    per channel per iteration) — not all upfront. This is what lets a graph
+    revisit an actor (A → B → A): A's later node blocks on B's edge only
+    AFTER A's earlier node has produced and shipped B's input. Channels the
+    loop's nodes never consume (the driver's pacing tick) are read first,
+    so source loops stay paced by execute() calls.
+
+    Returns the number of completed iterations (resolved by the loop's
+    ObjectRef after teardown, so the driver can surface loop crashes)."""
+    consumed = {
+        payload
+        for n in nodes
+        for kind, payload in list(n.args) + list(n.kwargs.values())
+        if kind == SRC_CHAN
+    }
+    pacing = [i for i in range(len(in_channels)) if i not in consumed]
+    iterations = 0
+    while True:
+        try:
+            msgs: Dict[int, Tuple[str, Any]] = {}
+            stopping = False
+            for i in pacing:
+                msgs[i] = in_channels[i].read()
+                if msgs[i][0] == STOP:
+                    stopping = True
+            stopping = _run_iteration(
+                instance, nodes, in_channels, out_channels, msgs, stopping
+            )
+        except ChannelClosedError:
+            return iterations
+        if stopping:
+            return iterations
+        iterations += 1
+
+
+def _run_iteration(instance, nodes, in_channels, out_channels, msgs,
+                   stopping: bool) -> bool:
+    """One seq through this loop's nodes; returns True when the stop
+    sentinel passed through (forwarded downstream before returning)."""
+    local: Dict[int, Tuple[str, Any]] = {}
+
+    def resolve(src) -> Tuple[str, Any]:
+        kind, payload = src
+        if kind == SRC_CHAN:
+            m = msgs.get(payload)
+            if m is None:
+                m = msgs[payload] = in_channels[payload].read()
+            return m
+        if kind == SRC_LOCAL:
+            return local[payload]
+        return (VAL, payload)
+
+    for node in nodes:
+        arg_msgs = [resolve(s) for s in node.args]
+        kw_msgs = {k: resolve(s) for k, s in node.kwargs.items()}
+        all_msgs = list(arg_msgs) + list(kw_msgs.values())
+        # message priority: stop > err > value. At the stop seq EVERY edge
+        # carries the sentinel, so forwarding it per node keeps all
+        # downstream loops draining in order.
+        if stopping or any(m[0] == STOP for m in all_msgs):
+            stopping = True
+            result: Tuple[str, Any] = (STOP, None)
+        else:
+            upstream_err = next((m for m in all_msgs if m[0] == ERR), None)
+            if upstream_err is not None:
+                result = upstream_err
+            else:
+                try:
+                    fn = node.resolve_callable(instance)
+                    value = fn(*[m[1] for m in arg_msgs],
+                               **{k: m[1] for k, m in kw_msgs.items()})
+                    result = (VAL, value)
+                except BaseException as e:  # noqa: BLE001 - user exception
+                    result = (ERR, exc.TaskError.from_exception(e))
+        if node.keep_local:
+            local[node.key] = result
+        for idx in node.out_channels:
+            try:
+                out_channels[idx].write(result)
+            except (ChannelClosedError, ChannelTimeoutError):
+                raise  # teardown / backpressure: not a result error
+            except Exception as e:  # noqa: BLE001 - oversized OR unpicklable
+                # result: the seq slot must still be filled (as an ERR that
+                # surfaces at ref.get()) or the graph misaligns — and the
+                # loop itself must survive, matching interpreted semantics
+                out_channels[idx].write((ERR, exc.TaskError.from_exception(e)))
+    return stopping

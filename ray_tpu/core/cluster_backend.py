@@ -310,8 +310,11 @@ class ClusterBackend(Backend):
         self.core.kill_actor(actor_id, no_restart)
 
     def free_actor(self, actor_id):
+        # fire-and-forget: this runs from ActorHandle.__del__, which GC may
+        # invoke on ANY thread — including the io-loop thread itself, where
+        # a blocking kill would deadlock the loop
         try:
-            self.core.kill_actor(actor_id, True)
+            self.core.kill_actor(actor_id, True, wait=False)
         except Exception:  # noqa: BLE001 - interpreter shutdown
             pass
 

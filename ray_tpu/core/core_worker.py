@@ -1532,12 +1532,27 @@ class CoreWorker:
         self._actor_addr_cache[actor_id] = info["address"]
         return info["address"]
 
-    def kill_actor(self, actor_id: ActorID, no_restart: bool):
-        self.io.run(
-            self.gcs.call(
-                "kill_actor", actor_id=actor_id.binary(), no_restart=no_restart
-            )
+    def kill_actor(self, actor_id: ActorID, no_restart: bool,
+                   wait: bool = True):
+        """wait=False fires the kill without blocking on the reply — the
+        ONLY safe mode from GC/__del__ paths: a handle collected while the
+        io-loop thread itself is allocating (ActorHandle.__del__ →
+        free_actor) would otherwise io.run() against its own loop and
+        deadlock the whole process (caught by test_cluster_runtime hanging
+        under suite-level GC pressure)."""
+        coro = self.gcs.call(
+            "kill_actor", actor_id=actor_id.binary(), no_restart=no_restart
         )
+        if wait:
+            self.io.run(coro)
+        else:
+            async def fire(c=coro):
+                try:
+                    await c
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
+
+            self.io.spawn(fire())
         self._actor_addr_cache.pop(actor_id.binary(), None)
 
     def get_named_actor(self, name: str, namespace: Optional[str]) -> ActorID:

@@ -295,7 +295,13 @@ class GcsServer:
 
     def handle_unsubscribe(self, conn, channels: List[str]):
         for ch in channels:
-            self.subscribers.get(ch, set()).discard(conn)
+            subs = self.subscribers.get(ch)
+            if subs is not None:
+                subs.discard(conn)
+                if not subs:
+                    # drop the empty set: transient user channels (pubsub)
+                    # would otherwise accumulate keys forever
+                    del self.subscribers[ch]
         return True
 
     async def handle_publish(self, conn, channel: str, payload) -> int:

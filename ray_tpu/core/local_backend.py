@@ -203,10 +203,18 @@ class LocalBackend(Backend):
 
         def run():
             try:
+                from ray_tpu.actor import CGRAPH_CALL_METHOD
+
                 actor.ensure_initialized()
                 rargs, rkwargs = self._resolve_args(args, kwargs)
-                method = getattr(actor.instance, method_name)
-                result = method(*rargs, **rkwargs)
+                if method_name == CGRAPH_CALL_METHOD:
+                    # generic entry point: fn(instance, *args) — compiled
+                    # graph loops and other framework code on user actors
+                    fn, rargs = rargs[0], rargs[1:]
+                    result = fn(actor.instance, *rargs, **rkwargs)
+                else:
+                    method = getattr(actor.instance, method_name)
+                    result = method(*rargs, **rkwargs)
                 import inspect
 
                 if inspect.iscoroutine(result):

@@ -182,6 +182,11 @@ class Connection:
     def on_push(self, channel: str, fn: Callable[[Any], Any]):
         self._push_handlers[channel] = fn
 
+    def off_push(self, channel: str) -> None:
+        """Remove a channel's push handler (pairs with on_push; callers must
+        not reach into _push_handlers)."""
+        self._push_handlers.pop(channel, None)
+
     async def _send(self, msg):
         try:
             async with self._writer_lock:
@@ -417,6 +422,14 @@ class EventLoopThread:
 
     def run(self, coro, timeout: Optional[float] = None):
         """Run a coroutine on the loop from a foreign thread, blocking."""
+        if threading.get_ident() == self._thread.ident:
+            # blocking on our own loop can never complete; fail loudly
+            # instead of deadlocking the whole process (reachable via GC
+            # running a __del__ on the loop thread)
+            coro.close()
+            raise RuntimeError(
+                "EventLoopThread.run() called from the loop thread itself"
+            )
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 

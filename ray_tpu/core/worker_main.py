@@ -315,11 +315,19 @@ class WorkerAgent(CoreWorker):
             return self._error_result(spec, self._actor_init_error)
         self._record_task_event(spec, "RUNNING")
         try:
-            method = getattr(self.actor_instance, spec.actor_method)
+            from ray_tpu.actor import CGRAPH_CALL_METHOD
+
             args, kwargs = ts.decode_args(
                 spec.args, spec.kwargs, lambda refs: self.get(refs, None)
             )
-            result = method(*args, **kwargs)
+            if spec.actor_method == CGRAPH_CALL_METHOD:
+                # generic entry point: fn(instance, *args) — compiled graph
+                # loops and other framework code on user actors
+                fn, args = args[0], args[1:]
+                result = fn(self.actor_instance, *args, **kwargs)
+            else:
+                method = getattr(self.actor_instance, spec.actor_method)
+                result = method(*args, **kwargs)
             import inspect
 
             if inspect.iscoroutine(result):

@@ -3,12 +3,15 @@ function_node.py, class_node.py, input_node.py). Used by Serve deployment graphs
 and Workflow.
 
 A DAG node records a computation without executing it; ``.execute()`` walks the
-graph submitting tasks/actors through the normal API.
+graph submitting tasks/actors through the normal API. For hot repeated
+execution, ``.experimental_compile()`` turns the bound graph into a static
+plan with pre-allocated actor channels (see ray_tpu/cgraph/) — same dataflow,
+no per-call task submission.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, List, Tuple
 
 
 class DAGNode:
@@ -31,9 +34,22 @@ class DAGNode:
     def execute(self, input_value: Any = None):
         raise NotImplementedError
 
+    def experimental_compile(self, *, max_in_flight: int = 16,
+                             buffer_size_bytes: int = 4 << 20):
+        """Compile this bound graph into a static execution plan with
+        pre-allocated channels between the participating actors. Returns a
+        ``ray_tpu.cgraph.CompiledDAG``; call ``.execute(x)`` repeatedly and
+        ``.teardown()`` when done."""
+        from ray_tpu.cgraph import compile_dag
+
+        return compile_dag(self, max_in_flight=max_in_flight,
+                           buffer_size_bytes=buffer_size_bytes)
+
 
 class InputNode(DAGNode):
-    """Placeholder for the DAG's runtime input."""
+    """Placeholder for the DAG's runtime input. Subscripting (``inp[0]``,
+    ``inp["k"]``) selects one positional/keyword argument of
+    ``execute(*args, **kwargs)`` for multi-input graphs."""
 
     def __init__(self):
         super().__init__((), {})
@@ -44,8 +60,39 @@ class InputNode(DAGNode):
     def __exit__(self, *a):
         return False
 
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key)
+
     def execute(self, input_value=None):
         return input_value
+
+
+class InputAttributeNode(DAGNode):
+    """``inp[k]``: one field of the runtime input (int → positional arg,
+    str → keyword arg; applied to the raw input when execute() is called
+    with a single already-structured value)."""
+
+    def __init__(self, input_node: InputNode, key):
+        super().__init__((), {})
+        self._input_node = input_node
+        self._key = key
+
+    def execute(self, input_value=None):
+        return input_value[self._key]
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node returning every member's output as a list (multi-output
+    graphs; reference: ray.dag.MultiOutputNode)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+        if not outputs:
+            raise ValueError("MultiOutputNode needs at least one output")
+        self.outputs = list(outputs)
+
+    def execute(self, input_value=None):
+        return [self._resolve(o, input_value) for o in self.outputs]
 
 
 class FunctionNode(DAGNode):
@@ -81,9 +128,13 @@ class ClassNode(DAGNode):
 
 
 class ClassMethodNode(DAGNode):
-    def __init__(self, class_node: ClassNode, method_name: str):
+    """A method call on an actor: either a ClassNode (actor created lazily by
+    the DAG) or a live ActorHandle (``handle.method.bind(...)``)."""
+
+    def __init__(self, class_node, method_name: str):
         super().__init__((), {})
-        self._class_node = class_node
+        self._class_node = class_node if isinstance(class_node, ClassNode) else None
+        self._handle = None if self._class_node is not None else class_node
         self._method_name = method_name
 
     def bind(self, *args, **kwargs):
@@ -91,10 +142,17 @@ class ClassMethodNode(DAGNode):
         self._bound_kwargs = kwargs
         return self
 
+    def resolve_handle(self, input_value=None):
+        """The actor executing this node (creates ClassNode actors on first
+        use; used by both interpreted execute and cgraph compile)."""
+        if self._handle is not None:
+            return self._handle
+        return self._class_node.execute(input_value)
+
     def execute(self, input_value=None):
         import ray_tpu
 
-        handle = self._class_node.execute(input_value)
+        handle = self.resolve_handle(input_value)
         args, kwargs = self._resolved_args(input_value)
         args = [ray_tpu.get(a) if isinstance(a, ray_tpu.ObjectRef) else a for a in args]
         return getattr(handle, self._method_name).remote(*args, **kwargs)

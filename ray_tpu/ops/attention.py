@@ -162,7 +162,7 @@ def _mha_forward_bhsd(
     Skv = k.shape[2]
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Skv, block_k)
-    bh = block_h if H % block_h == 0 else 1
+    bh = block_h if block_h > 0 and H % block_h == 0 else 1
     grid = (B, H // bh, Sq // bq)
     # Precomputed additive causal mask, only valid for zero offsets (the
     # single-device path — ring attention passes live offsets and keeps the
@@ -320,7 +320,7 @@ def _mha_backward_bhsd(
     Skv = k.shape[2]
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Skv, block_k)
-    bh = block_h if H % block_h == 0 else 1
+    bh = block_h if block_h > 0 and H % block_h == 0 else 1
 
     # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
     delta = jnp.sum(

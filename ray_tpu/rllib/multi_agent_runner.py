@@ -174,7 +174,12 @@ class MultiAgentEnvRunner:
             pid: SampleBatch.concat_samples(parts) for pid, parts in per_policy.items()
         }
         metrics = {
-            "num_env_steps": T * N * len(agents),
+            # num_env_steps counts ENV steps (T ticks x N vector envs), the
+            # same contract as the single-agent runner — so PPO's
+            # train_batch_size means the same thing in both paths; per-agent
+            # experience volume is reported separately as agent-steps
+            "num_env_steps": T * N,
+            "num_agent_steps": T * N * len(agents),
             "worker_index": self.worker_index,
             "episode_returns_per_agent": {
                 a: list(self._episode_returns[a]) for a in agents

@@ -28,10 +28,18 @@ class _ReplicaSet:
         self.actors: List[Any] = []          # ActorHandles
         self.target: int = 0
         self.last_scale_change: float = 0.0
-        # actor id → creation time: brand-new replicas get a startup grace
-        # before health checks count (replica init may be slow — imports,
-        # composition handle resolution — especially on loaded hosts)
-        self.born: Dict[int, float] = {}
+        # replica key (the actor's unique id bytes, NOT Python id(handle) —
+        # object ids recycle, which credited brand-new replicas with a dead
+        # predecessor's age and skipped their startup grace) → creation
+        # time: new replicas get a grace window before health checks count
+        # (replica init may be slow — imports, composition handle
+        # resolution — especially on loaded hosts)
+        self.born: Dict[bytes, float] = {}
+
+
+def _replica_key(actor) -> bytes:
+    """Stable per-replica identity for startup-grace bookkeeping."""
+    return actor._actor_id.binary()
 
 
 # a replica that hasn't answered a health check within this window of its
@@ -145,7 +153,7 @@ class ServeController:
             alive = []
             now = time.monotonic()
             for a in rs.actors:
-                born = rs.born.setdefault(id(a), now)
+                born = rs.born.setdefault(_replica_key(a), now)
                 try:
                     ray_tpu.get(a.check_health.remote(), timeout=10)
                     alive.append(a)
@@ -154,21 +162,21 @@ class ServeController:
                         alive.append(a)  # probably still starting up
                     else:
                         self._stop_replicas([a])
-                        rs.born.pop(id(a), None)
+                        rs.born.pop(_replica_key(a), None)
                         changed = True
                 except Exception:  # noqa: BLE001 - replica died
                     self._stop_replicas([a])
-                    rs.born.pop(id(a), None)
+                    rs.born.pop(_replica_key(a), None)
                     changed = True
             rs.actors = alive
             while len(rs.actors) < rs.target:
                 new = self._start_replica(dep)
-                rs.born[id(new)] = time.monotonic()
+                rs.born[_replica_key(new)] = time.monotonic()
                 rs.actors.append(new)
                 changed = True
             while len(rs.actors) > rs.target:
                 extra = rs.actors.pop()
-                rs.born.pop(id(extra), None)
+                rs.born.pop(_replica_key(extra), None)
                 self._stop_replicas([extra])
                 changed = True
         if changed:

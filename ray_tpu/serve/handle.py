@@ -54,23 +54,28 @@ class Router:
     def assign_request(self, deployment: str, *args, **kwargs):
         return self.assign_request_with_replica(deployment, *args, **kwargs)[0]
 
-    def assign_request_with_replica(self, deployment: str, *args, **kwargs):
-        """Pick a replica (power of two choices on local in-flight counts)
-        and dispatch; returns (ObjectRef, replica handle) — streaming keeps
-        pulling chunks from the SAME replica."""
+    def wait_for_replicas(self, deployment: str, timeout: float = 30.0):
+        """Block until the deployment has live replicas; returns the list
+        (shared by request assignment and compiled-handle pinning)."""
         self._refresh()
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + timeout
         while True:
             with self._lock:
-                replicas = self._replicas.get(deployment) or []
+                replicas = list(self._replicas.get(deployment) or ())
             if replicas:
-                break
+                return replicas
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"no replicas for deployment {deployment!r}"
                 )
             time.sleep(0.1)
             self._refresh(force=True)
+
+    def assign_request_with_replica(self, deployment: str, *args, **kwargs):
+        """Pick a replica (power of two choices on local in-flight counts)
+        and dispatch; returns (ObjectRef, replica handle) — streaming keeps
+        pulling chunks from the SAME replica."""
+        replicas = self.wait_for_replicas(deployment)
         with self._lock:
             counts = self._inflight.setdefault(deployment, {})
             if len(replicas) == 1:
@@ -109,6 +114,26 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         return self._router.assign_request(self.deployment_name, *args, **kwargs)
 
+    def compile(self, *, max_in_flight: int = 8) -> "CompiledDeploymentHandle":
+        """Compiled fast path: pin ONE replica and stream requests through a
+        pre-allocated channel pair (ray_tpu/cgraph/) instead of per-request
+        task submission. Trades routing (no load balancing, no failover to
+        other replicas) for dispatch latency — the Serve analog of what
+        vLLM does with compiled graphs for pipeline parallelism. The graph
+        loop occupies one of the replica's ``max_ongoing_requests``
+        concurrency slots (health checks and routed requests keep the
+        rest); a replica can host at most one compiled handle at a time.
+        Call ``.teardown()`` when done."""
+        from ray_tpu.cgraph import actor_in_compiled_graph
+
+        replicas = self._router.wait_for_replicas(self.deployment_name)
+        free = [r for r in replicas if not actor_in_compiled_graph(r)]
+        # prefer a replica no other compiled handle has pinned; if all are
+        # taken, fall through and let compile raise its clear error
+        replica = (free or replicas)[0]
+        return CompiledDeploymentHandle(self.deployment_name, replica,
+                                        max_in_flight=max_in_flight)
+
     def stream(self, *args, **kwargs):
         """Iterate a streaming deployment's chunks as they are produced
         (parity: the reference's streaming handles / replica.py:231). A
@@ -128,3 +153,29 @@ class DeploymentHandle:
             if chunk.get("done"):
                 return
             yield chunk["value"]
+
+
+class CompiledDeploymentHandle:
+    """One pinned replica behind a compiled single-node graph; see
+    DeploymentHandle.compile(). ``remote()`` returns a CompiledDAGRef
+    (``.get()`` for the result); exceptions raised by the deployment
+    surface at get() like on the routed path."""
+
+    def __init__(self, deployment_name: str, replica, *, max_in_flight: int = 8):
+        from ray_tpu.dag import InputNode
+
+        self.deployment_name = deployment_name
+        self._replica = replica
+        with InputNode() as inp:
+            dag = replica.handle_request.bind(inp)
+        self._compiled = dag.experimental_compile(max_in_flight=max_in_flight)
+
+    def remote(self, request, timeout: Optional[float] = None):
+        """Submit one request (a single positional value; use a tuple/dict
+        for structured payloads). Blocks when max_in_flight requests are
+        already buffered."""
+        return self._compiled.execute(request, timeout=timeout)
+
+    def teardown(self):
+        """Release the pinned replica back to ordinary routed serving."""
+        self._compiled.teardown()

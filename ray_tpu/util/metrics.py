@@ -238,12 +238,14 @@ def render_prometheus(series_list: List[dict]) -> str:
                 cum = 0
                 for i, b in enumerate(s["boundaries"]):
                     cum += val[i]
+                    le = 'le="%s"' % b
                     out.append(
-                        f"{name}_bucket{_fmt_tags(tags, f'le=\"{b}\"')} {cum}"
+                        f"{name}_bucket{_fmt_tags(tags, le)} {cum}"
                     )
                 cum += val[len(s["boundaries"])]
+                le_inf = 'le="+Inf"'
                 out.append(
-                    f"{name}_bucket{_fmt_tags(tags, 'le=\"+Inf\"')} {cum}"
+                    f"{name}_bucket{_fmt_tags(tags, le_inf)} {cum}"
                 )
                 out.append(f"{name}_sum{_fmt_tags(tags)} {val[-2]}")
                 out.append(f"{name}_count{_fmt_tags(tags)} {val[-1]}")

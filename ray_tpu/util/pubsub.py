@@ -83,7 +83,7 @@ def _detach(core, wire_channel: str, q: "queue.Queue") -> bool:
         if qs:
             return False
         _fanout.pop(wire_channel, None)
-        core.gcs._push_handlers.pop(wire_channel, None)
+        core.gcs.off_push(wire_channel)
         return True
 
 

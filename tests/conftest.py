@@ -23,6 +23,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run"
+    )
+
+
 @pytest.fixture
 def ray_start_local():
     """In-process (local mode) runtime — fast unit-test fixture."""

@@ -36,7 +36,10 @@ def test_multi_agent_env_and_runner_mapping():
     # left serves two agents -> twice the rows of right
     assert len(batches["left"]) == 2 * len(batches["right"]) == 2 * 16 * 4
     assert "advantages" in batches["left"]
-    assert metrics["num_env_steps"] == 16 * 4 * 3
+    # env-steps follow the single-agent contract (T ticks x N envs);
+    # per-agent experience volume is a separate key
+    assert metrics["num_env_steps"] == 16 * 4
+    assert metrics["num_agent_steps"] == 16 * 4 * 3
 
 
 def test_shared_policy_learns_multi_agent_cartpole():
