@@ -24,6 +24,15 @@ per-call task submission, no control-plane round trips, and up to
         outs = [r.get() for r in refs]
     finally:
         compiled.teardown()
+
+Fault tolerance: the compiled graph subscribes to its participants' actor
+state, so a dead participant raises ``ActorDiedError`` from
+``execute()``/``ref.get()`` promptly instead of timing out on a dead ring.
+When every participant was created with ``max_restarts != 0``, the graph is
+recoverable: ``compiled.recover()`` (or ``experimental_compile(...,
+auto_recover=True)``) waits out the restarts, re-allocates channels on a
+fresh epoch, re-installs the loops, and resumes at the next seq — in-flight
+executions fail with a precise per-seq error.
 """
 
 from ray_tpu.cgraph.channel import (

@@ -111,6 +111,12 @@ class ShmChannel:
     def __reduce__(self):
         return (ShmChannel, (self.path,))
 
+    def __repr__(self):
+        return (
+            f"ShmChannel({os.path.basename(self.path)}, "
+            f"closed={self.closed})"
+        )
+
     # ------------------------------------------------------------- helpers
     def _u64(self, off: int) -> int:
         return _U64.unpack_from(self._mm, off)[0]
@@ -228,6 +234,11 @@ class IntraProcessChannel:
         raise TypeError(
             "IntraProcessChannel cannot cross a process boundary; compiled "
             "graphs allocate ShmChannels for cross-process edges"
+        )
+
+    def __repr__(self):
+        return (
+            f"IntraProcessChannel(len={len(self._q)}, closed={self._closed})"
         )
 
     def write(self, obj: Any, timeout: Optional[float] = None) -> None:

@@ -5,19 +5,33 @@
 1. topo-sort the runtime nodes (FunctionNode / ClassMethodNode); resolve
    every ClassNode to a live actor handle; give each FunctionNode a
    dedicated executor actor (plain functions have no resident process);
-2. pre-allocate one channel per cross-loop edge — shared-memory ring
-   buffers (channel.ShmChannel) in cluster mode, in-process buffers in
-   local mode — plus driver→graph input channels and graph→driver output
-   channels; edges between nodes on the SAME actor stay loop-local (no
-   channel, no serialization);
-3. install one long-lived execution loop per participating actor via the
+2. plan one channel SLOT per cross-loop edge, plus driver→graph input slots
+   and graph→driver output slots; edges between nodes on the SAME actor stay
+   loop-local (no channel, no serialization);
+3. materialize the slots into channels — shared-memory ring buffers
+   (channel.ShmChannel) in cluster mode, in-process buffers in local mode —
+   and install one long-lived execution loop per participating actor via the
    generic ``__ray_tpu_call__`` entry point (executor.node_loop).
 
-``execute(*args)`` then just pickles the input into the input rings and
-returns a ``CompiledDAGRef``; ``ref.get()`` awaits the output ring. No task
+The plan (step 2) is separate from materialization (step 3) so the graph can
+RECOVER from a participant death: ``recover()`` waits out RESTARTING
+participants, re-materializes every slot into fresh channels (a new epoch),
+and re-installs the loops — in-flight executions fail with a precise per-seq
+error while execution resumes at the next seq.
+
+``execute(*args)`` pickles the input into the input rings and returns a
+``CompiledDAGRef``; ``ref.get()`` awaits the output ring. No task
 submission, no ObjectRef round-trips per call, and up to ``max_in_flight``
 executions overlap per edge (microbatch pipelining — submitting past that
 bound blocks until results are consumed).
+
+Fault tolerance: the graph subscribes to its participants' actor state
+(GCS "actor" pubsub in cluster mode, backend callbacks in local mode), so a
+dead participant surfaces as ``ActorDiedError`` from ``execute()``/``get()``
+within ~one probe interval instead of burning the caller's full timeout on a
+dead ring. Participants created with ``max_restarts != 0`` are recoverable:
+``dag.recover()`` (or compiling with ``auto_recover=True``) resumes on the
+restarted actors.
 
 Error semantics: an exception in any node is forwarded through the graph as
 an ("err", ...) message so the pipeline stays aligned, and re-raises at
@@ -32,6 +46,7 @@ import uuid
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu import exceptions as exc_mod
 from ray_tpu.cgraph import executor as ex
 from ray_tpu.cgraph.channel import (
     ChannelClosedError,
@@ -39,6 +54,7 @@ from ray_tpu.cgraph.channel import (
     IntraProcessChannel,
     ShmChannel,
 )
+from ray_tpu.core.config import _config
 from ray_tpu.dag import (
     ClassMethodNode,
     ClassNode,
@@ -80,11 +96,17 @@ def actor_in_compiled_graph(actor_handle) -> bool:
         return actor_handle._actor_id.binary() in _actors_in_use
 
 
+class _RecoverNeeded(Exception):
+    """Internal: a recoverable participant failure was detected and the
+    graph was compiled with auto_recover=True — run recover() and retry."""
+
+
 class CompiledDAGRef:
     """Result handle for one ``execute()`` call; ``get()`` blocks on the
     output channel. The first successful get() moves the result out of the
     driver's seq buffer onto this ref (so long-running pipelines don't
-    accumulate consumed results); repeat gets return the cached value."""
+    accumulate consumed results); repeat gets return the cached value. A ref
+    garbage-collected without get() evicts its buffered result."""
 
     _UNSET = object()
 
@@ -101,51 +123,70 @@ class CompiledDAGRef:
             return self._value
         try:
             self._value = self._dag._get_result(self._seq, timeout)
-        except ChannelTimeoutError:
-            raise  # retryable: the result is still in flight
+        except (ChannelTimeoutError, exc_mod.ActorUnavailableError):
+            raise  # retryable: in flight, or resumable after dag.recover()
         except BaseException as e:
             self._error = e
             raise
         return self._value
+
+    def __del__(self):
+        # never get()'d: release the dag's buffered result for this seq —
+        # the driver-side _results cache must not grow with abandoned refs
+        if self._value is CompiledDAGRef._UNSET and self._error is None:
+            dag = getattr(self, "_dag", None)
+            if dag is not None:
+                try:
+                    dag._discard_result(self._seq)
+                except Exception:  # noqa: BLE001 - interpreter shutdown
+                    pass
 
     def __repr__(self):
         return f"CompiledDAGRef(seq={self._seq})"
 
 
 class _Loop:
-    """Plan state for one participating actor."""
+    """Plan + runtime state for one participating actor. Channel SLOTS
+    (indices into the dag's slot table) are fixed at compile time; the
+    channel objects themselves are (re-)created per epoch by
+    ``CompiledDAG._materialize``."""
 
     def __init__(self, handle):
         self.handle = handle
         self.nodes: List[ex.ExecNode] = []
-        self.in_channels: List[Any] = []
-        self.in_index: Dict[Any, int] = {}   # edge key -> in_channels index
+        self.in_slots: List[int] = []
+        self.in_index: Dict[Any, int] = {}    # edge key -> in_slots index
+        self.out_slots: List[int] = []
+        self.in_channels: List[Any] = []      # materialized per epoch
         self.out_channels: List[Any] = []
         self.ref = None                       # the loop task's ObjectRef
 
-    def in_channel(self, key, make_channel) -> int:
+    def in_slot(self, key, make_slot) -> int:
         idx = self.in_index.get(key)
         if idx is None:
-            ch = make_channel()
-            idx = len(self.in_channels)
-            self.in_channels.append(ch)
+            slot = make_slot()
+            idx = len(self.in_slots)
+            self.in_slots.append(slot)
             self.in_index[key] = idx
         return idx
 
-    def add_out_channel(self, ch) -> int:
-        self.out_channels.append(ch)
-        return len(self.out_channels) - 1
+    def add_out_slot(self, slot: int) -> int:
+        self.out_slots.append(slot)
+        return len(self.out_slots) - 1
 
 
 def compile_dag(dag: DAGNode, *, max_in_flight: int = 16,
-                buffer_size_bytes: int = 4 << 20) -> "CompiledDAG":
+                buffer_size_bytes: int = 4 << 20,
+                auto_recover: bool = False) -> "CompiledDAG":
     return CompiledDAG(dag, max_in_flight=max_in_flight,
-                       buffer_size_bytes=buffer_size_bytes)
+                       buffer_size_bytes=buffer_size_bytes,
+                       auto_recover=auto_recover)
 
 
 class CompiledDAG:
     def __init__(self, dag: DAGNode, *, max_in_flight: int = 16,
-                 buffer_size_bytes: int = 4 << 20):
+                 buffer_size_bytes: int = 4 << 20,
+                 auto_recover: bool = False):
         import ray_tpu  # noqa: F401 - ensures runtime init below
         from ray_tpu.api import _auto_init, _global_worker
 
@@ -156,10 +197,12 @@ class CompiledDAG:
                 "experimental_compile is not supported over ray:// client "
                 "connections (channels need host shared memory)"
             )
+        self._backend = backend
         self._core = getattr(backend, "core", None)
         self._graph_id = uuid.uuid4().hex[:12]
         self.max_in_flight = max(1, max_in_flight)
         self.buffer_size_bytes = buffer_size_bytes
+        self.auto_recover = auto_recover
         # separate locks so teardown() (which only flips the flag before
         # closing channels) can never deadlock behind an execute()/get()
         # blocked inside a channel operation
@@ -170,13 +213,30 @@ class CompiledDAG:
         self._broken: Optional[str] = None
         self._submitted = 0
         self._next_result_seq = 0
-        self._results: Dict[int, List[Tuple[str, Any]]] = {}
+        self._results: Dict[int, Any] = {}
         # output messages already consumed for the in-progress seq: a get()
         # timeout between output-channel reads must NOT drop them, or a
         # retry would re-read channel 0 one seq ahead and misalign forever
         self._partial_entry: List[Tuple[str, Any]] = []
+        # GC'd-without-get() seqs whose buffered results should be evicted
+        self._abandoned: set = set()
+        self._abandoned_lock = threading.Lock()
+        # seq -> weakref to its CompiledDAGRef: the cache backstop only
+        # evicts seqs whose ref is provably gone (a live ref's result is
+        # never dropped out from under the caller)
+        self._issued_refs: Dict[int, Any] = {}
+        # channel plan: slot count + wiring; channels materialize per epoch
+        self._epoch = 0
+        self._num_slots = 0
+        self._input_slots: List[Tuple[Any, int]] = []   # (accessor, slot)
+        self._output_slots: List[int] = []              # driver idx -> slot
         self._channels: List[Any] = []
         self._fn_actors: List[Any] = []
+        # participant fault tracking (fed by the backend's actor listener)
+        self._participants: Dict[bytes, Any] = {}       # id bytes -> handle
+        self._failed: Dict[bytes, str] = {}             # id bytes -> reason
+        self._failure_event = threading.Event()
+        self._listening = False
         try:
             self._compile(dag)
         except BaseException:
@@ -198,10 +258,22 @@ class CompiledDAG:
                 except Exception:  # noqa: BLE001
                     pass
             raise
+        # subscribe to participant state so a death surfaces promptly at
+        # execute()/get() and recover() knows what it is waiting for
+        try:
+            self._backend.add_actor_listener(self._on_actor_event)
+            self._listening = True
+        except Exception:  # noqa: BLE001 - probes still catch dead loops
+            pass
         _live_graphs.add(self)
 
     # ----------------------------------------------------------- channels
-    def _make_channel(self):
+    def _new_slot(self) -> int:
+        slot = self._num_slots
+        self._num_slots += 1
+        return slot
+
+    def _make_channel(self, slot: int):
         if self._core is not None:
             import os
 
@@ -210,8 +282,10 @@ class CompiledDAG:
             d = os.path.join(shm_store.session_dir(self._core.session),
                              f"cgraph_{self._graph_id}")
             os.makedirs(d, exist_ok=True)
+            # epoch in the name: a recovering graph must never re-attach a
+            # surviving loop to a stale ring file
             ch = ShmChannel(
-                os.path.join(d, f"chan_{len(self._channels)}"),
+                os.path.join(d, f"chan_e{self._epoch}_{slot}"),
                 capacity=self.buffer_size_bytes,
                 max_msgs=self.max_in_flight,
                 create=True,
@@ -276,6 +350,9 @@ class CompiledDAG:
                 if fopts.resources:
                     kw["resources"] = dict(fopts.resources)
                 kw.setdefault("num_cpus", 0)
+                # executor actors are stateless: always restartable, so a
+                # killed function stage never blocks dag.recover()
+                kw.setdefault("max_restarts", -1)
                 actor_cls = ray_tpu.remote(**kw)(ex.FnExecutorActor)
                 a = actor_cls.remote()
                 self._fn_actors.append(a)
@@ -312,15 +389,15 @@ class CompiledDAG:
                     exec_nodes[id(dep)].keep_local = True
                     return (ex.SRC_LOCAL, keys[id(dep)])
                 key = ("node", id(dep), id(consumer_loop))
-                idx = consumer_loop.in_channel(
-                    key, lambda: self._edge_channel(dep, producer_loop, key)
+                idx = consumer_loop.in_slot(
+                    key, lambda: self._edge_slot(dep, key)
                 )
                 return (ex.SRC_CHAN, idx)
             if isinstance(dep, (InputNode, InputAttributeNode)):
                 accessor = dep._key if isinstance(dep, InputAttributeNode) else None
                 key = ("input", id(dep), id(consumer_loop))
-                idx = consumer_loop.in_channel(
-                    key, lambda: self._input_channel(accessor)
+                idx = consumer_loop.in_slot(
+                    key, lambda: self._input_slot(accessor)
                 )
                 return (ex.SRC_CHAN, idx)
             if isinstance(dep, ClassNode):
@@ -329,9 +406,8 @@ class CompiledDAG:
                 raise ValueError("MultiOutputNode can only be the graph root")
             return (ex.SRC_CONST, dep)
 
-        # producer-side out-channel registry, filled by _edge_channel
-        self._pending_out: Dict[Any, Tuple[Any, Any]] = {}
-        self._input_channels: List[Tuple[Any, Any]] = []  # (accessor, chan)
+        # producer-side out-slot registry, filled by _edge_slot
+        self._pending_out: Dict[Any, Tuple[Any, int]] = {}
 
         for n in order:
             loop = loop_of[id(n)]
@@ -349,25 +425,24 @@ class CompiledDAG:
             en.kwargs = {k: source_for(v, loop)
                          for k, v in n._bound_kwargs.items()}
 
-        # register producer-side out-channel indexes (deferred because the
+        # register producer-side out-slot indexes (deferred because the
         # producer's ExecNode may not exist yet when the edge is created)
-        for producer, ch in self._pending_out.values():
-            idx = loop_of[id(producer)].add_out_channel(ch)
+        for producer, slot in self._pending_out.values():
+            idx = loop_of[id(producer)].add_out_slot(slot)
             exec_nodes[id(producer)].out_channels.append(idx)
         del self._pending_out
 
-        # 4) output channels: one per unique output node, read by the driver
+        # 4) output slots: one per unique output node, read by the driver
         self._output_chan_of: Dict[int, int] = {}   # id(node) -> driver index
-        self._output_channels: List[Any] = []
         self._output_positions: List[int] = []      # position -> driver index
         for o in outputs:
             didx = self._output_chan_of.get(id(o))
             if didx is None:
-                ch = self._make_channel()
-                didx = len(self._output_channels)
-                self._output_channels.append(ch)
+                slot = self._new_slot()
+                didx = len(self._output_slots)
+                self._output_slots.append(slot)
                 self._output_chan_of[id(o)] = didx
-                idx = loop_of[id(o)].add_out_channel(ch)
+                idx = loop_of[id(o)].add_out_slot(slot)
                 exec_nodes[id(o)].out_channels.append(idx)
             self._output_positions.append(didx)
         self._single_output = not isinstance(dag, MultiOutputNode)
@@ -375,26 +450,109 @@ class CompiledDAG:
         # 5) every loop must be paced by at least one driver-fed channel,
         # or a source loop would free-run ahead of execute() calls
         for loop in loops.values():
-            if not loop.in_channels:
-                ch = self._input_channel(_TICK)
-                loop.in_channels.append(ch)
+            if not loop.in_slots:
+                loop.in_slots.append(self._input_slot(_TICK))
 
-        # 6) install the loops (one long-lived actor task each)
+        # 6) materialize the slots into channels and install the loops
         self._loops = list(loops.values())
+        self._participants = {
+            loop.handle._actor_id.binary(): loop.handle
+            for loop in self._loops
+        }
+        self._materialize()
+
+    def _edge_slot(self, producer, key) -> int:
+        slot = self._new_slot()
+        self._pending_out[key] = (producer, slot)
+        return slot
+
+    def _input_slot(self, accessor) -> int:
+        slot = self._new_slot()
+        self._input_slots.append((accessor, slot))
+        return slot
+
+    def _materialize(self):
+        """Create this epoch's channels for every planned slot, wire them
+        into the loops/driver, and install the execution loops (one
+        long-lived actor task each). Called at compile time and again by
+        recover()."""
+        self._channels = []
+        chans = [self._make_channel(s) for s in range(self._num_slots)]
+        self._input_channels = [(acc, chans[s]) for acc, s in self._input_slots]
+        self._output_channels = [chans[s] for s in self._output_slots]
         for loop in self._loops:
+            loop.in_channels = [chans[s] for s in loop.in_slots]
+            loop.out_channels = [chans[s] for s in loop.out_slots]
             loop.ref = loop.handle._call_with_instance(
                 ex.node_loop, loop.nodes, loop.in_channels, loop.out_channels
             )
 
-    def _edge_channel(self, producer, producer_loop: _Loop, key):
-        ch = self._make_channel()
-        self._pending_out[key] = (producer, ch)
-        return ch
+    # ------------------------------------------------- participant tracking
+    def _on_actor_event(self, actor_id: bytes, state: str, reason: str):
+        if actor_id not in self._participants or self._torn_down:
+            return
+        if state in ("RESTARTING", "DEAD"):
+            self._failed[actor_id] = reason or state.lower()
+            self._failure_event.set()
 
-    def _input_channel(self, accessor):
-        ch = self._make_channel()
-        self._input_channels.append((accessor, ch))
-        return ch
+    def _classify_failure(self):
+        """A participant failed: raise the precise user-facing error —
+        ActorDiedError for unrecoverable deaths, _RecoverNeeded when
+        auto-recovery should kick in, ActorUnavailableError otherwise."""
+        recoverable = False
+        for aid in list(self._failed):
+            handle = self._participants.get(aid)
+            state = (
+                self._backend.actor_state(handle._actor_id)
+                if handle is not None else "DEAD"
+            )
+            if state == "DEAD":
+                raise exc_mod.ActorDiedError(
+                    handle._actor_id if handle is not None else None,
+                    "compiled-graph participant died and cannot restart "
+                    f"({self._failed[aid]}); the graph is unrecoverable — "
+                    "teardown() and recompile over live actors",
+                )
+            recoverable = True
+        if recoverable:
+            if self.auto_recover:
+                raise _RecoverNeeded()
+            raise exc_mod.ActorUnavailableError(
+                "compiled-graph participant(s) restarting "
+                f"({', '.join(r for r in self._failed.values())}); call "
+                "dag.recover() to re-establish channels and resume"
+            )
+
+    def _probe_failure(self):
+        """A blocked execute()/get() slice expired: distinguish 'still in
+        flight' from 'the graph is dead' — participant state first (pushed,
+        so it is prompt), then the loop tasks themselves."""
+        if self._failure_event.is_set():
+            self._classify_failure()
+        import ray_tpu
+
+        for loop in self._loops:
+            ready, _ = ray_tpu.wait([loop.ref], timeout=0)
+            if not ready:
+                continue
+            try:
+                ray_tpu.get(loop.ref)
+            except BaseException as e:
+                if isinstance(e, exc_mod.ActorError):
+                    # the loop's death raced ahead of the pubsub event:
+                    # record it and classify exactly like a pushed event
+                    self._failed.setdefault(
+                        loop.handle._actor_id.binary(), str(e)
+                    )
+                    self._failure_event.set()
+                    self._classify_failure()
+                raise RuntimeError(
+                    "compiled graph execution loop died"
+                ) from e
+            raise RuntimeError(
+                "a compiled graph execution loop exited early "
+                "(actor torn down?)"
+            )
 
     # ------------------------------------------------------------ execute
     def _extract_input(self, accessor, args, kwargs):
@@ -412,12 +570,32 @@ class CompiledDAG:
             return args[accessor]
         return kwargs[accessor]
 
+    def _with_auto_recover(self, attempt_fn):
+        """Run ``attempt_fn`` with up to two transparent recover() rounds
+        when the graph was compiled with auto_recover=True (recoverable
+        failures surface as _RecoverNeeded from the failure probes)."""
+        for _ in range(3):
+            try:
+                return attempt_fn()
+            except _RecoverNeeded:
+                self.recover()
+        raise exc_mod.ActorUnavailableError(
+            "compiled graph kept losing participants across auto-recover "
+            "attempts; giving up"
+        )
+
     def execute(self, *args, timeout: Optional[float] = None, **kwargs):
         """Push one input through the graph; returns a CompiledDAGRef.
 
         Blocks (up to ``timeout``) when ``max_in_flight`` executions are
         already buffered on an input edge — consuming results with
-        ``ref.get()`` frees the slots."""
+        ``ref.get()`` frees the slots. With ``auto_recover=True``, a
+        recoverable participant death triggers recover() transparently."""
+        return self._with_auto_recover(
+            lambda: self._execute_attempt(args, kwargs, timeout)
+        )
+
+    def _execute_attempt(self, args, kwargs, timeout: Optional[float]):
         with self._exec_lock:
             self._check_usable()
             if not self._input_channels:
@@ -429,6 +607,7 @@ class CompiledDAG:
             import time as _time
 
             deadline = None if timeout is None else _time.monotonic() + timeout
+            probe = max(0.05, _config.cgraph_probe_interval_s)
             wrote = 0
             try:
                 for ch, v in values:
@@ -442,17 +621,22 @@ class CompiledDAG:
                             else deadline - _time.monotonic()
                         )
                         if remaining is not None and remaining <= 0:
-                            self._raise_if_loop_died()
+                            self._probe_failure()
                             raise ChannelTimeoutError(
                                 "execute() input write timed out"
                             )
-                        step = 5.0 if remaining is None else min(remaining, 5.0)
+                        step = probe if remaining is None else min(remaining, probe)
                         try:
                             ch.write((ex.VAL, v), timeout=step)
                             break
                         except ChannelTimeoutError:
-                            self._raise_if_loop_died()
+                            self._probe_failure()
                     wrote += 1
+            except _RecoverNeeded:
+                # the partially-written seq dies with the old channels —
+                # recover() re-materializes them empty, so the wrapper's
+                # retry rewrites ALL inputs consistently
+                raise
             except BaseException:
                 # not just timeouts: an oversized or unpicklable input can
                 # raise from write() too, and a partially-written seq would
@@ -465,54 +649,117 @@ class CompiledDAG:
                 raise
             seq = self._submitted
             self._submitted += 1
-            return CompiledDAGRef(self, seq)
+            ref = CompiledDAGRef(self, seq)
+            self._issued_refs[seq] = weakref.ref(ref)
+            return ref
 
     def _check_usable(self):
         if self._torn_down:
             raise RuntimeError("compiled graph was torn down")
+        if self._failure_event.is_set():
+            self._classify_failure()
         if self._broken:
             raise RuntimeError(self._broken)
 
+    def _discard_result(self, seq: int) -> None:
+        """A CompiledDAGRef was GC'd without get(): drop its buffered (or
+        future) result so the driver cache can't grow unbounded."""
+        with self._abandoned_lock:
+            self._abandoned.add(seq)
+
+    def _prune_results(self) -> None:
+        # called under _read_lock: evict abandoned seqs, then enforce the
+        # bounded-size backstop — oldest first, but ONLY seqs whose
+        # CompiledDAGRef is gone (a live ref's buffered result is never
+        # dropped out from under the caller; if every entry is live the
+        # cache grows past the limit, which is the caller holding results
+        # it asked for)
+        with self._abandoned_lock:
+            if self._abandoned:
+                for seq in [s for s in self._results if s in self._abandoned]:
+                    del self._results[seq]
+                    self._issued_refs.pop(seq, None)
+                    self._abandoned.discard(seq)
+        limit = max(1, _config.cgraph_result_cache_limit)
+        if len(self._results) > limit:
+            for seq in sorted(self._results):
+                if len(self._results) <= limit:
+                    break
+                wr = self._issued_refs.get(seq)
+                if wr is not None and wr() is not None:
+                    continue  # ref still live: never evict under it
+                del self._results[seq]
+                self._issued_refs.pop(seq, None)
+
     def _get_result(self, seq: int, timeout: Optional[float]):
+        return self._with_auto_recover(
+            lambda: self._get_result_attempt(seq, timeout)
+        )
+
+    def _drain_one_result(self, read_timeout: Optional[float]) -> None:
+        """Read the next seq's full output entry (resuming _partial_entry
+        so an interrupted drain never re-reads channel 0 and misaligns) and
+        store it. Shared by the get() path and recover()'s salvage pass —
+        the two MUST stay byte-identical for seq alignment. Raises
+        ChannelTimeoutError when a channel has nothing within the slice."""
+        entry = self._partial_entry
+        while len(entry) < len(self._output_channels):
+            entry.append(
+                self._output_channels[len(entry)].read(timeout=read_timeout)
+            )
+        self._results[self._next_result_seq] = entry
+        self._partial_entry = []
+        self._next_result_seq += 1
+        self._prune_results()
+
+    def _get_result_attempt(self, seq: int, timeout: Optional[float]):
         import time as _time
 
         with self._read_lock:
-            self._check_usable()
+            # deliberately NOT the full _check_usable: a seq that completed
+            # before a participant died is still readable from the output
+            # rings — only a BLOCKED read should classify the failure
+            if self._torn_down:
+                raise RuntimeError("compiled graph was torn down")
+            if self._broken:
+                raise RuntimeError(self._broken)
             if seq >= self._submitted:
                 raise ValueError(f"seq {seq} was never submitted")
             deadline = None if timeout is None else _time.monotonic() + timeout
-            while self._next_result_seq <= seq:
-                # read in bounded slices, probing the loops between slices:
-                # a dead actor never sets the channel's closed flag, so a
-                # plain timeout=None read would hang instead of surfacing
-                # the loop's death. Messages already read for this seq live
-                # in _partial_entry so a timeout + retry resumes where it
+            probe = max(0.05, _config.cgraph_probe_interval_s)
+            while self._next_result_seq <= seq and seq not in self._results:
+                # drain in bounded slices, probing for failures between
+                # slices: a dead actor never sets the channel's closed flag,
+                # so a plain timeout=None read would hang instead of
+                # surfacing the death. _drain_one_result resumes from
+                # _partial_entry, so a timeout + retry continues where it
                 # left off instead of re-reading channel 0.
-                entry = self._partial_entry
-                while len(entry) < len(self._output_channels):
-                    ch = self._output_channels[len(entry)]
-                    remaining = (
-                        None if deadline is None
-                        else deadline - _time.monotonic()
+                remaining = (
+                    None if deadline is None
+                    else deadline - _time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    self._probe_failure()
+                    raise ChannelTimeoutError(
+                        f"result seq {seq} not ready within timeout"
                     )
-                    if remaining is not None and remaining <= 0:
-                        self._raise_if_loop_died()
-                        raise ChannelTimeoutError(
-                            f"result seq {seq} not ready within timeout"
-                        )
-                    step = 5.0 if remaining is None else min(remaining, 5.0)
-                    try:
-                        entry.append(ch.read(timeout=step))
-                    except ChannelTimeoutError:
-                        self._raise_if_loop_died()
-                self._results[self._next_result_seq] = entry
-                self._partial_entry = []
-                self._next_result_seq += 1
+                step = probe if remaining is None else min(remaining, probe)
+                try:
+                    self._drain_one_result(step)
+                except ChannelTimeoutError:
+                    self._probe_failure()
             # moved onto the CompiledDAGRef by get(); keeping consumed
             # entries here would leak for the lifetime of a hot pipeline
             entry = self._results.pop(seq, None)
+            self._issued_refs.pop(seq, None)
             if entry is None:
-                raise RuntimeError(f"result for seq {seq} already consumed")
+                raise RuntimeError(
+                    f"result for seq {seq} already consumed, or evicted by "
+                    "the cgraph_result_cache_limit backstop"
+                )
+        if isinstance(entry, BaseException):
+            # recover() marked this in-flight seq as lost
+            raise entry
         msgs = [entry[didx] for didx in self._output_positions]
         for kind, payload in msgs:
             if kind == ex.STOP:
@@ -527,24 +774,88 @@ class CompiledDAG:
             return msgs[0][1]
         return [payload for _, payload in msgs]
 
-    def _raise_if_loop_died(self):
-        """A get() timeout may really be a dead loop (actor died, loop
-        crashed): surface that error instead of the generic timeout."""
+    # ----------------------------------------------------------- recovery
+    def recover(self, timeout: Optional[float] = None) -> "CompiledDAG":
+        """Resume after a participant death: wait out RESTARTING→ALIVE for
+        every participant (actors created with ``max_restarts != 0``),
+        re-materialize every channel slot (fresh epoch), re-install the
+        execution loops, and resume at the next seq. Executions that were in
+        flight at the failure resolve with a per-seq ActorDiedError at their
+        ``ref.get()``. Raises ActorDiedError if any participant is dead for
+        good. Idempotent when nothing failed."""
+        import time as _time
+
         import ray_tpu
 
-        for loop in self._loops:
-            ready, _ = ray_tpu.wait([loop.ref], timeout=0)
-            if ready:
+        timeout = (
+            timeout if timeout is not None
+            else _config.cgraph_recover_timeout_s
+        )
+        with self._exec_lock, self._read_lock:
+            if self._torn_down:
+                raise RuntimeError("compiled graph was torn down")
+            if not self._failed:
+                return self
+            # 0) salvage results already sitting in the output rings: a seq
+            # that completed before the failure must not be reported lost
+            try:
+                while self._next_result_seq < self._submitted:
+                    self._drain_one_result(0.05)
+            except (ChannelTimeoutError, ChannelClosedError):
+                pass
+            deadline = _time.monotonic() + timeout
+            # 1) every participant must come back ALIVE (DEAD → raise)
+            for aid, handle in self._participants.items():
+                if self._backend.actor_state(handle._actor_id) == "ALIVE":
+                    continue
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise exc_mod.GetTimeoutError(
+                        "recover() timed out waiting for participants"
+                    )
+                self._backend.wait_actor_alive(handle._actor_id, remaining)
+            # a teardown() may have completed while we waited (it only takes
+            # _flag_lock, by design): materializing now would resurrect
+            # loops and rings nothing will ever stop
+            if self._torn_down:
+                raise RuntimeError("compiled graph was torn down")
+            # 2) retire the old epoch: closing unblocks surviving loops
+            # (they exit with ChannelClosedError); join best-effort
+            for ch in self._channels:
                 try:
-                    ray_tpu.get(loop.ref)
-                except BaseException as e:
-                    raise RuntimeError(
-                        "compiled graph execution loop died"
-                    ) from e
-                raise RuntimeError(
-                    "a compiled graph execution loop exited early "
-                    "(actor torn down?)"
-                )
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            for loop in self._loops:
+                try:
+                    ray_tpu.get(loop.ref, timeout=5.0)
+                except Exception:  # noqa: BLE001 - died with the actor
+                    pass
+            for ch in self._channels:
+                try:
+                    ch.unlink()
+                except Exception:  # noqa: BLE001
+                    pass
+            # 3) fail the in-flight seqs with a precise per-seq error
+            reasons = ", ".join(sorted(set(self._failed.values()))) or "?"
+            for seq in range(self._next_result_seq, self._submitted):
+                if seq not in self._results:
+                    self._results[seq] = exc_mod.ActorDiedError(
+                        None,
+                        f"in-flight compiled-graph execution (seq={seq}) "
+                        f"was lost when a participant died ({reasons}); "
+                        f"the graph recovered and resumes at "
+                        f"seq={self._submitted}",
+                    )
+            self._partial_entry = []
+            self._next_result_seq = self._submitted
+            self._broken = None
+            self._failed.clear()
+            self._failure_event.clear()
+            # 4) fresh epoch: new channels, new loops, same plan
+            self._epoch += 1
+            self._materialize()
+        return self
 
     # ----------------------------------------------------------- teardown
     def teardown(self, timeout: float = 10.0):
@@ -553,6 +864,12 @@ class CompiledDAG:
             if self._torn_down:
                 return
             self._torn_down = True
+        if self._listening:
+            try:
+                self._backend.remove_actor_listener(self._on_actor_event)
+            except Exception:  # noqa: BLE001
+                pass
+            self._listening = False
         # stop sentinel first (graceful: loops drain in seq order), then
         # close every channel — closing is what unblocks a loop stuck on a
         # full/empty ring, and pre-close messages still deliver, so the

@@ -76,6 +76,8 @@ def node_loop(instance, nodes: List[ExecNode], in_channels: List[Any],
 
     Returns the number of completed iterations (resolved by the loop's
     ObjectRef after teardown, so the driver can surface loop crashes)."""
+    from ray_tpu.testing import chaos
+
     consumed = {
         payload
         for n in nodes
@@ -83,9 +85,18 @@ def node_loop(instance, nodes: List[ExecNode], in_channels: List[Any],
         if kind == SRC_CHAN
     }
     pacing = [i for i in range(len(in_channels)) if i not in consumed]
+    loop_key = ",".join(n.method_name or "<fn>" for n in nodes)
     iterations = 0
     while True:
         try:
+            # chaos injection point "cgraph.iter": kill this participant at
+            # the Nth loop iteration (cluster: real SIGKILL of the worker;
+            # local mode: the backend fails the actor and ChaosKilled unwinds
+            # this thread) — the deterministic mid-pipeline death the
+            # compiled-graph recovery tests drive.
+            act = chaos.fire("cgraph.iter", key=loop_key)
+            if act is not None and act.get("action") == "kill":
+                chaos.perform_kill_self(f"cgraph chaos kill ({loop_key})")
             msgs: Dict[int, Tuple[str, Any]] = {}
             stopping = False
             for i in pacing:

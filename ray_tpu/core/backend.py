@@ -93,3 +93,28 @@ class Backend(abc.ABC):
 
     def free_actor(self, actor_id: ActorID) -> None:
         """Called when the last local ActorHandle is GC'd (out-of-scope kill)."""
+
+    # --- fault-tolerance plane (compiled graphs, serve failover) -------------
+    def actor_state(self, actor_id: ActorID) -> str:
+        """Current lifecycle state: PENDING | ALIVE | RESTARTING | DEAD,
+        or UNKNOWN when the control plane is unreachable (callers must
+        treat UNKNOWN as maybe-alive, never as death)."""
+        return "ALIVE"
+
+    def wait_actor_alive(self, actor_id: ActorID, timeout: float) -> None:
+        """Block until the actor is ALIVE. Raises ActorDiedError when it is
+        (or becomes) DEAD, GetTimeoutError on timeout."""
+
+    def add_actor_listener(self, cb) -> None:
+        """Subscribe ``cb(actor_id_bytes, state, reason)`` to actor lifecycle
+        transitions (compiled graphs watch their participants through this)."""
+
+    def remove_actor_listener(self, cb) -> None:
+        pass
+
+    def create_deferred(self):
+        """Allocate a driver-owned ObjectRef fulfilled later by framework
+        code: returns ``(ref, fulfill)`` where ``fulfill(value=..)`` /
+        ``fulfill(error=..)`` resolves it, or None when unsupported (serve
+        uses this to retry a request behind one stable user-facing ref)."""
+        return None

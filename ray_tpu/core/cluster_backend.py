@@ -309,6 +309,77 @@ class ClusterBackend(Backend):
     def kill_actor(self, actor_id, no_restart):
         self.core.kill_actor(actor_id, no_restart)
 
+    # ------------------------------------------------- fault-tolerance plane
+    def actor_state(self, actor_id) -> str:
+        try:
+            info = self.core.io.run(
+                self.core._gcs_call_retrying(
+                    "get_actor", actor_id=actor_id.binary(), timeout=30
+                )
+            )
+        except (rpc.RpcError, rpc.ConnectionLost):
+            # a GCS blip must NOT read as actor death: callers treat
+            # UNKNOWN as maybe-alive (retry/wait), never as terminal
+            return "UNKNOWN"
+        return "DEAD" if info is None else info["state"]
+
+    def wait_actor_alive(self, actor_id, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise exc.GetTimeoutError(
+                    f"actor {actor_id.hex()[:16]} not ALIVE within {timeout}s"
+                )
+            try:
+                info = self.core.io.run(
+                    self.core._gcs_call_retrying(
+                        "get_actor", actor_id=actor_id.binary(),
+                        wait_alive=True,
+                        wait_timeout=min(remaining, 10.0), timeout=30,
+                    )
+                )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                time.sleep(0.2)
+                continue
+            if info is None or info["state"] == "DEAD":
+                reason = (info or {}).get("death_reason", "") or "dead"
+                raise exc.ActorDiedError(actor_id, reason)
+            if info["state"] == "ALIVE":
+                return
+
+    def add_actor_listener(self, cb) -> None:
+        self.core.add_actor_listener(cb)
+
+    def remove_actor_listener(self, cb) -> None:
+        self.core.remove_actor_listener(cb)
+
+    def create_deferred(self):
+        from ray_tpu.core import serialization
+        from ray_tpu.core.config import _config
+        from ray_tpu.core.ids import ObjectID
+
+        core = self.core
+        oid = ObjectID.for_put(core.worker_id)
+        core._own(oid)
+        ref = ObjectRef(oid, owner_addr=core.address)
+
+        def fulfill(value=None, error=None):
+            if error is not None:
+                err = (
+                    error if isinstance(error, exc.RayTpuError)
+                    else exc.TaskError.from_exception(error)
+                )
+                core.memory_store.put_error(oid, err)
+                return
+            data = serialization.serialize(value).to_bytes()
+            if len(data) <= _config.max_direct_call_object_size:
+                core.memory_store.put_value(oid, data)
+            else:
+                core._put_shm(oid, data)
+
+        return ref, fulfill
+
     def free_actor(self, actor_id):
         # fire-and-forget: this runs from ActorHandle.__del__, which GC may
         # invoke on ANY thread — including the io-loop thread itself, where

@@ -69,6 +69,22 @@ class Config:
     task_max_retries: int = 3
     actor_max_restarts: int = 0
 
+    # --- fault tolerance ----------------------------------------------------
+    # compiled graphs: how often a blocked execute()/get() probes participant
+    # actor state, so a dead ring surfaces as ActorDiedError instead of
+    # burning the caller's full timeout
+    cgraph_probe_interval_s: float = 1.0
+    # how long dag.recover()/auto_recover waits for RESTARTING participants
+    cgraph_recover_timeout_s: float = 60.0
+    # driver-side bound on buffered results for refs never get()'d (backstop
+    # behind CompiledDAGRef-GC eviction)
+    cgraph_result_cache_limit: int = 256
+    # serve: retries of a request whose replica died mid-flight (each retry
+    # routes to a different, healthy replica)
+    serve_request_retries: int = 1
+    # train: per-round driver wait on worker polls before probing liveness
+    train_poll_timeout_s: float = 120.0
+
     # --- logging / events ---------------------------------------------------
     log_to_driver: bool = True
     task_events_buffer_size: int = 10_000

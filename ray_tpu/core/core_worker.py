@@ -188,6 +188,9 @@ class CoreWorker:
         self.raylet: Optional[rpc.Connection] = None
         self.address: Optional[str] = None
         self._lock = threading.Lock()
+        # actor lifecycle listeners fed by the GCS "actor" pubsub channel
+        # (compiled graphs subscribe their participants here)
+        self._actor_listeners: List[Any] = []
 
     # ------------------------------------------------------------ lifecycle
     def connect(self):
@@ -238,6 +241,39 @@ class CoreWorker:
         for line in batch.get("lines", []):
             print(f"({src}) {line}", file=sys.stderr, flush=True)
 
+    # ------------------------------------------------ actor lifecycle plane
+    def add_actor_listener(self, cb) -> None:
+        """Subscribe ``cb(actor_id_bytes, state, reason)`` to cluster-wide
+        actor state transitions (GCS "actor" channel; the GCS publishes on
+        every ready/failed/restarting/dead edge)."""
+        with self._lock:
+            first = not self._actor_listeners
+            self._actor_listeners.append(cb)
+        if first:
+            try:
+                self.io.run(self._subscribe_actor_events(), timeout=30)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass  # watchdog re-subscribes on reconnect
+
+    def remove_actor_listener(self, cb) -> None:
+        with self._lock:
+            try:
+                self._actor_listeners.remove(cb)
+            except ValueError:
+                pass
+
+    async def _subscribe_actor_events(self):
+        self.gcs.on_push("actor", self._on_actor_push)
+        await self.gcs.call("subscribe", channels=["actor"])
+
+    def _on_actor_push(self, info: dict):
+        for cb in list(self._actor_listeners):
+            try:
+                cb(info["actor_id"], info["state"],
+                   info.get("death_reason") or "")
+            except Exception:  # noqa: BLE001 - listeners must not break io
+                logger.exception("actor listener failed")
+
     async def _metrics_flush_loop(self):
         """Flush this process's metrics registry (util/metrics.py) to the
         GCS — covers user-defined Counters/Gauges/Histograms recorded in
@@ -274,6 +310,11 @@ class CoreWorker:
                 if self.mode == "driver":
                     await self.gcs.call("register_driver")
                     await self._subscribe_logs()
+                if self._actor_listeners:
+                    try:
+                        await self._subscribe_actor_events()
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
                 # functions registered <1s before the crash may have missed
                 # the snapshot: re-register everything we know from cache so
                 # outstanding fn_ids stay resolvable

@@ -145,6 +145,12 @@ class GcsServer:
     async def start(self):
         if self.store_path:
             self._restore_store()
+        # chaos "exit" action (restart_gcs injection): crash AFTER flushing
+        # the durable snapshot — the deterministic analog of the old
+        # sleep-until-snapshot-then-SIGKILL test pattern
+        from ray_tpu.testing import chaos
+
+        chaos.set_exit_callback(self._chaos_pre_exit)
         await self.server.start()
         self._bg.append(asyncio.create_task(self._health_check_loop()))
         if self.store_path:
@@ -158,6 +164,10 @@ class GcsServer:
         if self.store_path:
             self._write_snapshot()
         await self.server.close()
+
+    def _chaos_pre_exit(self) -> None:
+        if self.store_path:
+            self._write_snapshot()
 
     # --------------------------------------------------- fault tolerance
     def _durable_state(self) -> dict:

@@ -18,6 +18,11 @@ from ray_tpu.core.backend import Backend
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.options import RemoteOptions
 from ray_tpu.core.refs import ObjectRef
+from ray_tpu.testing import chaos
+
+# which actor's task the current thread is executing (chaos kill-self needs
+# to know whom to fail; mirrors the worker process knowing its own actor)
+_current_actor = threading.local()
 
 
 class _LocalActor:
@@ -26,18 +31,28 @@ class _LocalActor:
         self.options = options
         self.dead = False
         self.death_reason = ""
+        self.state = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+        self.restarts_left = options.max_restarts or 0
+        self.num_restarts = 0
         # refs of submitted-but-unfinished tasks; errored out if the actor dies
         self.pending_refs: set = set()
         # ordered execution: one dispatch thread pulling a FIFO queue mirrors the
         # sequential actor scheduling queue (max_concurrency>1 uses a pool).
-        n = max(1, options.max_concurrency)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=n, thread_name_prefix=f"actor-{actor_id.hex()[:8]}"
-        )
+        self._pool = self._new_pool()
         self.instance = None
         self._init_future = None
+        # construction recipe, kept for restarts (cluster parity: the GCS
+        # keeps the creation TaskSpec and replays it on a fresh worker)
+        self._recipe = None
+
+    def _new_pool(self):
+        n = max(1, self.options.max_concurrency)
+        return concurrent.futures.ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix=f"actor-{self.actor_id.hex()[:8]}"
+        )
 
     def start(self, cls, args, kwargs, resolve_args, on_failure):
+        self._recipe = (cls, args, kwargs, resolve_args, on_failure)
         self._init_future = self._pool.submit(
             self._construct, cls, args, kwargs, resolve_args, on_failure
         )
@@ -46,11 +61,28 @@ class _LocalActor:
         try:
             rargs, rkwargs = resolve_args(args, kwargs)
             self.instance = cls(*rargs, **rkwargs)
+            self.state = "ALIVE"
         except BaseException as e:  # noqa: BLE001 - surfaced via init future
             self.dead = True
+            self.state = "DEAD"
             self.death_reason = f"__init__ failed: {e!r}"
             on_failure(self)
             raise
+
+    def restart(self, on_alive):
+        """Re-create the instance on a fresh pool (simulated worker restart:
+        state is lost, like a cluster actor restarting on a new process)."""
+        cls, args, kwargs, resolve_args, on_failure = self._recipe
+        self.state = "RESTARTING"
+        self.num_restarts += 1
+        self._pool = self._new_pool()
+        self.instance = None
+
+        def construct():
+            self._construct(cls, args, kwargs, resolve_args, on_failure)
+            on_alive()
+
+        self._init_future = self._pool.submit(construct)
 
     def submit(self, fn, *args):
         return self._pool.submit(fn, *args)
@@ -60,6 +92,7 @@ class _LocalActor:
 
     def stop(self, resolve_pending=None):
         self.dead = True
+        self.state = "DEAD"
         self._pool.shutdown(wait=False, cancel_futures=True)
         if resolve_pending:
             resolve_pending(list(self.pending_refs))
@@ -74,6 +107,93 @@ class LocalBackend(Backend):
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._lock = threading.Lock()
         self._cancelled: set = set()
+        self._actor_listeners: List[Any] = []
+        # chaos "kill" actions executed on an actor thread route here
+        chaos.set_local_actor_killer(self._chaos_kill_current)
+
+    # ------------------------------------------------- actor lifecycle plane
+    def _emit_actor_event(self, actor_id: ActorID, state: str, reason: str = ""):
+        for cb in list(self._actor_listeners):
+            try:
+                cb(actor_id.binary(), state, reason)
+            except Exception:  # noqa: BLE001 - listeners must not break us
+                pass
+
+    def add_actor_listener(self, cb):
+        self._actor_listeners.append(cb)
+
+    def remove_actor_listener(self, cb):
+        try:
+            self._actor_listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def actor_state(self, actor_id: ActorID) -> str:
+        actor = self._actors.get(actor_id)
+        if actor is None or actor.dead:
+            return "DEAD"
+        return actor.state
+
+    def wait_actor_alive(self, actor_id: ActorID, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            state = self.actor_state(actor_id)
+            if state == "ALIVE":
+                return
+            if state == "DEAD":
+                actor = self._actors.get(actor_id)
+                raise exc.ActorDiedError(
+                    actor_id, getattr(actor, "death_reason", "") or "dead"
+                )
+            if time.monotonic() > deadline:
+                raise exc.GetTimeoutError(
+                    f"actor {actor_id.hex()[:16]} not ALIVE within {timeout}s"
+                )
+            time.sleep(0.02)
+
+    def _chaos_kill_current(self, reason: str) -> bool:
+        actor_id = getattr(_current_actor, "actor_id", None)
+        if actor_id is None:
+            return False
+        return self._fail_actor(actor_id, reason)
+
+    def _fail_actor(self, actor_id: ActorID, reason: str = "worker died") -> bool:
+        """Simulated unexpected worker death (chaos): pending calls resolve
+        with ActorDiedError; a ``max_restarts != 0`` actor restarts with
+        fresh state (cluster restart semantics), others die for good."""
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None or actor.dead or actor.state == "RESTARTING":
+                return False
+            err = exc.ActorDiedError(actor_id, reason)
+            pending = list(actor.pending_refs)
+            actor.pending_refs.clear()
+            restartable = actor.restarts_left != 0
+            if restartable and actor.restarts_left > 0:
+                actor.restarts_left -= 1
+        for r in pending:
+            fut = self._future_for(r.id)
+            if not fut.done():
+                try:
+                    fut.set_result(err)
+                except concurrent.futures.InvalidStateError:
+                    pass
+        actor._pool.shutdown(wait=False, cancel_futures=True)
+        actor.death_reason = reason
+        if restartable:
+            self._emit_actor_event(actor_id, "RESTARTING", reason)
+            actor.restart(
+                on_alive=lambda: self._emit_actor_event(actor_id, "ALIVE")
+            )
+        else:
+            actor.dead = True
+            actor.state = "DEAD"
+            with self._lock:
+                for key, aid in list(self._named_actors.items()):
+                    if aid == actor_id:
+                        del self._named_actors[key]
+            self._emit_actor_event(actor_id, "DEAD", reason)
+        return True
 
     # ------------------------------------------------------------------ utils
     def _future_for(self, oid: ObjectID) -> concurrent.futures.Future:
@@ -202,11 +322,23 @@ class LocalBackend(Backend):
         actor.pending_refs.update(refs)
 
         def run():
+            _current_actor.actor_id = actor_id
             try:
                 from ray_tpu.actor import CGRAPH_CALL_METHOD
 
                 actor.ensure_initialized()
                 rargs, rkwargs = self._resolve_args(args, kwargs)
+                # chaos injection point "actor.call": an active plan can kill
+                # this actor at the Nth matching call (before user code runs,
+                # like a worker SIGKILL racing the dispatch)
+                act = chaos.fire(
+                    "actor.call",
+                    key=f"{type(actor.instance).__name__}.{method_name}",
+                )
+                if act is not None and act.get("action") == "kill":
+                    chaos.perform_kill_self(
+                        f"chaos kill at {method_name}"
+                    )  # raises ChaosKilled after _fail_actor
                 if method_name == CGRAPH_CALL_METHOD:
                     # generic entry point: fn(instance, *args) — compiled
                     # graph loops and other framework code on user actors
@@ -225,6 +357,7 @@ class LocalBackend(Backend):
             except Exception as e:  # noqa: BLE001
                 self._store_error(refs, e)
             finally:
+                _current_actor.actor_id = None
                 actor.pending_refs.difference_update(refs)
 
         try:
@@ -253,6 +386,7 @@ class LocalBackend(Backend):
                 for key, aid in list(self._named_actors.items()):
                     if aid == actor_id:
                         del self._named_actors[key]
+            self._emit_actor_event(actor_id, "DEAD", actor.death_reason)
 
     def free_actor(self, actor_id):
         self.kill_actor(actor_id, True)
@@ -269,6 +403,24 @@ class LocalBackend(Backend):
         oid = ObjectID.for_put(self.worker_id)
         self._future_for(oid).set_result(value)
         return ObjectRef(oid)
+
+    def create_deferred(self):
+        oid = ObjectID.for_put(self.worker_id)
+        ref = ObjectRef(oid)
+        fut = self._future_for(oid)
+
+        def fulfill(value=None, error=None):
+            if error is not None:
+                value = (
+                    error if isinstance(error, exc.RayTpuError)
+                    else exc.TaskError.from_exception(error)
+                )
+            try:
+                fut.set_result(value)
+            except concurrent.futures.InvalidStateError:
+                pass
+
+        return ref, fulfill
 
     def get(self, refs, timeout):
         futs = [self._future_for(r.id) for r in refs]
@@ -375,6 +527,7 @@ class LocalBackend(Backend):
         raise ValueError(f"unknown state method {method!r}")
 
     def shutdown(self):
+        chaos.set_local_actor_killer(None)
         for a in list(self._actors.values()):
             a.stop()
         self._actors.clear()

@@ -537,6 +537,9 @@ class Raylet:
                  "worker_id": worker.worker_id}
             )
             logger.debug("lease %s granted -> %s", lease.lease_id[:8], worker.address)
+            # chaos: a plan may kill the worker at the Nth granted lease;
+            # poll_deaths reaps it and the owner's retry path takes over
+            self.pool.chaos_on_lease(worker)
 
     def _worker_cap(self) -> int:
         cap = _config.num_workers_soft_limit

@@ -132,6 +132,24 @@ class WorkerPool:
             pass
         handle.state = DEAD
 
+    def chaos_on_lease(self, handle: WorkerHandle) -> bool:
+        """Chaos injection point "worker.lease": fired by the raylet right
+        after it grants ``handle`` a task lease; an active plan can SIGKILL
+        the worker at the Nth grant (the owner's push then fails with
+        ConnectionLost → WorkerCrashedError → task retry). Returns True when
+        the worker was killed."""
+        from ray_tpu.testing import chaos
+
+        act = chaos.fire("worker.lease", key=str(handle.worker_id or ""))
+        if act is not None and act.get("action") == "kill":
+            logger.warning(
+                "CHAOS: killing leased worker pid=%d token=%d",
+                handle.proc.pid, handle.startup_token,
+            )
+            self.kill_worker(handle)
+            return True
+        return False
+
     def shutdown(self):
         for w in self.workers.values():
             try:

@@ -22,6 +22,8 @@ import threading
 import traceback
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ray_tpu.testing import chaos as _chaos
+
 logger = logging.getLogger(__name__)
 
 REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
@@ -188,6 +190,19 @@ class Connection:
         self._push_handlers.pop(channel, None)
 
     async def _send(self, msg):
+        if msg[0] == REQUEST:
+            # chaos injection point "rpc.send": drop/delay/sever the Nth
+            # matching request frame (ray_tpu/testing/chaos.py). No-op
+            # unless a plan is active.
+            act = _chaos.fire("rpc.send", key=str(msg[2]))
+            if act is not None:
+                if act["action"] == "drop":
+                    return
+                if act["action"] == "delay":
+                    await asyncio.sleep(act.get("delay_s") or 0.1)
+                elif act["action"] == "sever":
+                    await self._handle_close()
+                    raise ConnectionLost("chaos: connection severed")
         try:
             async with self._writer_lock:
                 self.writer.write(_frame(msg))
@@ -287,6 +302,18 @@ class Connection:
             result = fn(self, **payload)
             if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
                 result = await result
+            # chaos injection point "rpc.handle": after the handler ran,
+            # before the response — a process-exit here models a server
+            # crashing MID-CALL (state mutated, reply never sent), the exact
+            # window GCS fault-tolerance tests need to hit deterministically.
+            act = _chaos.fire("rpc.handle", key=str(method))
+            if act is not None:
+                if act["action"] == "exit":
+                    _chaos.perform_exit(f"rpc.handle {method}")
+                elif act["action"] == "drop":
+                    return  # swallow the response frame
+                elif act["action"] == "delay":
+                    await asyncio.sleep(act.get("delay_s") or 0.1)
             if msg_id:
                 await self._send((RESPONSE, msg_id, method, result))
         except ConnectionLost:

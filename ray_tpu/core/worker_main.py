@@ -316,7 +316,18 @@ class WorkerAgent(CoreWorker):
         self._record_task_event(spec, "RUNNING")
         try:
             from ray_tpu.actor import CGRAPH_CALL_METHOD
+            from ray_tpu.testing import chaos
 
+            # chaos injection point "actor.call": SIGKILL this dedicated
+            # worker at the Nth matching call (real process death — the
+            # raylet reaps it and the GCS runs restart/death handling)
+            act = chaos.fire(
+                "actor.call",
+                key=f"{type(self.actor_instance).__name__}."
+                    f"{spec.actor_method}",
+            )
+            if act is not None and act.get("action") == "kill":
+                chaos.perform_kill_self(f"chaos kill at {spec.actor_method}")
             args, kwargs = ts.decode_args(
                 spec.args, spec.kwargs, lambda refs: self.get(refs, None)
             )

@@ -35,15 +35,20 @@ class DAGNode:
         raise NotImplementedError
 
     def experimental_compile(self, *, max_in_flight: int = 16,
-                             buffer_size_bytes: int = 4 << 20):
+                             buffer_size_bytes: int = 4 << 20,
+                             auto_recover: bool = False):
         """Compile this bound graph into a static execution plan with
         pre-allocated channels between the participating actors. Returns a
         ``ray_tpu.cgraph.CompiledDAG``; call ``.execute(x)`` repeatedly and
-        ``.teardown()`` when done."""
+        ``.teardown()`` when done. With ``auto_recover=True`` the graph
+        transparently recovers from participant deaths when every
+        participant was created with ``max_restarts != 0`` (otherwise call
+        ``.recover()`` explicitly)."""
         from ray_tpu.cgraph import compile_dag
 
         return compile_dag(self, max_in_flight=max_in_flight,
-                           buffer_size_bytes=buffer_size_bytes)
+                           buffer_size_bytes=buffer_size_bytes,
+                           auto_recover=auto_recover)
 
 
 class InputNode(DAGNode):

@@ -110,6 +110,29 @@ class ServeController:
                 for name, rs in self._replicas.items()
             }
 
+    def report_dead_replica(self, name: str, replica_key: bytes) -> bool:
+        """A router observed a replica die mid-request: drop it from the
+        fleet immediately and bump the routing version, so every handle
+        refreshes away from it without waiting for the next health probe to
+        time out (the reconcile ticker starts the replacement)."""
+        with self._lock:
+            rs = self._replicas.get(name)
+            if rs is None:
+                return False
+            victims = [a for a in rs.actors if _replica_key(a) == replica_key]
+            for a in victims:
+                rs.actors.remove(a)
+                rs.born.pop(replica_key, None)
+        if not victims:
+            return False
+        self._stop_replicas(victims)  # ensure the process is really gone
+        self._bump()
+        logger.warning(
+            "replica of %r reported dead by a router; %s", name,
+            "replacement starts next reconcile tick",
+        )
+        return True
+
     def shutdown(self) -> bool:
         self._stop.set()
         for rs in self._replicas.values():

@@ -6,14 +6,31 @@ power-of-two-choices over per-replica in-flight counts the router tracks
 locally; the routing table refreshes from the controller only when its
 version moves (long-poll analog). The controller is never on the request
 path.
+
+Fault tolerance: a request whose replica dies mid-flight does NOT surface as
+a user-visible error. The router EVICTS the replica from its local routing
+set immediately (and reports the death to the controller, which starts a
+replacement), then retries the request once on a healthy replica — behind
+the same ObjectRef the caller already holds (a driver-owned deferred ref the
+retry chain fulfills). Parity: the reference router's
+retry-on-ActorUnavailable + LongPoll-driven replica eviction. Scope: covers
+remote(), the HTTP proxy path, and a stream's initial dispatch; a replica
+dying MID-stream surfaces to the consumer (its generator state died with it).
 """
 
 from __future__ import annotations
 
+import logging
+import queue as _queue
 import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu.core.config import _config
+
+logger = logging.getLogger(__name__)
 
 
 class Router:
@@ -22,9 +39,17 @@ class Router:
         self._version = -1
         self._replicas: Dict[str, List[Any]] = {}
         self._routes: Dict[str, str] = {}
-        self._inflight: Dict[str, Dict[int, int]] = {}  # dep → idx → count
+        # dep → replica-id bytes → in-flight count (keyed by stable
+        # replica identity, NOT list position: eviction reshuffles indices)
+        self._inflight: Dict[str, Dict[bytes, int]] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        # failover plane: dead-replica retries run on a dedicated thread
+        # (future callbacks fire on arbitrary threads — resubmission must
+        # not block them) and are counted for observability/tests
+        self.retry_count = 0
+        self._retry_queue: "_queue.Queue" = _queue.Queue()
+        self._retry_thread: Optional[threading.Thread] = None
 
     def _refresh(self, force: bool = False) -> None:
         import ray_tpu
@@ -43,16 +68,138 @@ class Router:
             self._replicas = table["deployments"]
             self._routes = table.get("routes", {})
             for name, replicas in self._replicas.items():
-                counts = self._inflight.setdefault(name, {})
-                for idx in range(len(replicas)):
-                    counts.setdefault(idx, 0)
+                old = self._inflight.get(name, {})
+                # carry live counts across refreshes; drop dead replicas'
+                self._inflight[name] = {
+                    r._actor_id.binary(): old.get(r._actor_id.binary(), 0)
+                    for r in replicas
+                }
 
     def deployment_for_route(self, path: str) -> Optional[str]:
         self._refresh()
         return self._routes.get(path)
 
     def assign_request(self, deployment: str, *args, **kwargs):
-        return self.assign_request_with_replica(deployment, *args, **kwargs)[0]
+        """Route one request; returns an ObjectRef. When the backend
+        supports deferred refs, the returned ref is fulfilled by a retry
+        chain: a replica death resolves it with the RETRIED result (one
+        retry on a healthy replica) instead of ActorDiedError."""
+        from ray_tpu.api import _global_worker
+
+        ref, replica = self.assign_request_with_replica(
+            deployment, *args, **kwargs
+        )
+        deferred = (
+            _global_worker().backend.create_deferred()
+            if _config.serve_request_retries > 0 else None
+        )
+        if deferred is None:  # retries disabled / no deferred-ref support
+            return ref
+        out_ref, fulfill = deferred
+        self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
+                           attempt=0)
+        return out_ref
+
+    # ------------------------------------------------------------- failover
+    def _arm_failover(self, deployment, ref, replica, args, kwargs, fulfill,
+                      attempt: int):
+        def done(fut):
+            try:
+                value = fut.result()
+            except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
+                self._on_replica_failure(deployment, replica)
+                if attempt < _config.serve_request_retries:
+                    self._enqueue_retry(
+                        deployment, args, kwargs, fulfill, attempt + 1
+                    )
+                else:
+                    fulfill(error=e)
+                return
+            except BaseException as e:  # noqa: BLE001 - user exception
+                fulfill(error=e)
+                return
+            fulfill(value=value)
+
+        try:
+            ref.future().add_done_callback(done)
+        except Exception as e:  # noqa: BLE001 - no future support
+            fulfill(error=e)
+
+    def _enqueue_retry(self, deployment, args, kwargs, fulfill, attempt):
+        with self._lock:
+            if self._retry_thread is None:
+                self._retry_thread = threading.Thread(
+                    target=self._retry_worker, daemon=True,
+                    name="serve-router-retry",
+                )
+                self._retry_thread.start()
+        self._retry_queue.put((deployment, args, kwargs, fulfill, attempt))
+
+    def _retry_worker(self):
+        while True:
+            deployment, args, kwargs, fulfill, attempt = self._retry_queue.get()
+            self.retry_count += 1
+            logger.warning(
+                "serve: retrying request to %r on a healthy replica "
+                "(attempt %d)", deployment, attempt,
+            )
+            try:
+                ref, replica = self.assign_request_with_replica(
+                    deployment, *args, **kwargs
+                )
+            except BaseException as e:  # noqa: BLE001 - no replicas left
+                fulfill(error=e)
+                continue
+            self._arm_failover(deployment, ref, replica, args, kwargs,
+                               fulfill, attempt)
+
+    def _on_replica_failure(self, deployment: str, replica) -> None:
+        """Evict a dead replica from the local routing set NOW (the next
+        controller version replaces the table wholesale) and tell the
+        controller so the replacement starts without waiting for its health
+        probe to time out."""
+        key = replica._actor_id.binary()
+        with self._lock:
+            lst = self._replicas.get(deployment) or []
+            kept = [r for r in lst if r._actor_id.binary() != key]
+            if len(kept) != len(lst):
+                self._replicas[deployment] = kept
+                counts = self._inflight.get(deployment)
+                if counts is not None:
+                    counts.pop(key, None)  # other replicas' counts survive
+                logger.warning(
+                    "serve: evicted dead replica of %r (%d left)",
+                    deployment, len(kept),
+                )
+        try:
+            self._controller.report_dead_replica.remote(deployment, key)
+        except Exception:  # noqa: BLE001 - controller reconcile still covers
+            pass
+
+    def call_with_failover(self, deployment: str, args=(), kwargs=None,
+                           timeout: float = 60.0):
+        """Blocking route+get with replica failover — the HTTP proxy's and
+        stream()'s dispatch path. Takes the request's args/kwargs as
+        explicit containers (so a deployment's own 'timeout' kwarg can
+        never collide with ours). Returns (result, replica); streaming
+        responses keep pulling chunks from the returned (healthy)
+        replica."""
+        import ray_tpu
+
+        kwargs = kwargs or {}
+        attempt = 0
+        while True:
+            ref, replica = self.assign_request_with_replica(
+                deployment, *args, **kwargs
+            )
+            try:
+                return ray_tpu.get(ref, timeout=timeout), replica
+            except (exc.ActorDiedError, exc.ActorUnavailableError):
+                self._on_replica_failure(deployment, replica)
+                attempt += 1
+                if attempt > _config.serve_request_retries:
+                    raise
+                self.retry_count += 1
 
     def wait_for_replicas(self, deployment: str, timeout: float = 30.0):
         """Block until the deployment has live replicas; returns the list
@@ -76,32 +223,34 @@ class Router:
         and dispatch; returns (ObjectRef, replica handle) — streaming keeps
         pulling chunks from the SAME replica."""
         replicas = self.wait_for_replicas(deployment)
+        keys = [r._actor_id.binary() for r in replicas]
         with self._lock:
             counts = self._inflight.setdefault(deployment, {})
             if len(replicas) == 1:
                 idx = 0
             else:
                 a, b = random.sample(range(len(replicas)), 2)
-                idx = a if counts.get(a, 0) <= counts.get(b, 0) else b
-            counts[idx] = counts.get(idx, 0) + 1
+                idx = (
+                    a if counts.get(keys[a], 0) <= counts.get(keys[b], 0)
+                    else b
+                )
+            rkey = keys[idx]
+            counts[rkey] = counts.get(rkey, 0) + 1
         ref = replicas[idx].handle_request.remote(*args, **kwargs)
-        self._track_completion(deployment, idx, ref)
+        self._track_completion(deployment, rkey, ref)
         return ref, replicas[idx]
 
-    def _track_completion(self, deployment: str, idx: int, ref) -> None:
-        import ray_tpu
-
+    def _track_completion(self, deployment: str, rkey: bytes, ref) -> None:
         def done(_):
             with self._lock:
                 counts = self._inflight.get(deployment)
-                if counts and counts.get(idx, 0) > 0:
-                    counts[idx] -= 1
+                if counts and counts.get(rkey, 0) > 0:
+                    counts[rkey] -= 1
 
         try:
             ref.future().add_done_callback(done)
         except Exception:  # noqa: BLE001 - backend without futures
-            with self._lock:
-                self._inflight[deployment][idx] -= 1
+            done(None)
 
 
 class DeploymentHandle:
@@ -137,13 +286,14 @@ class DeploymentHandle:
     def stream(self, *args, **kwargs):
         """Iterate a streaming deployment's chunks as they are produced
         (parity: the reference's streaming handles / replica.py:231). A
-        non-generator response yields once."""
+        non-generator response yields once. The INITIAL dispatch fails over
+        like remote(); once chunks flow, the stream is pinned to its replica
+        (generator state lives there), so a mid-stream death raises."""
         import ray_tpu
 
-        ref, replica = self._router.assign_request_with_replica(
-            self.deployment_name, *args, **kwargs
+        first, replica = self._router.call_with_failover(
+            self.deployment_name, args, kwargs, timeout=60
         )
-        first = ray_tpu.get(ref, timeout=60)
         if not (isinstance(first, dict) and "__serve_stream__" in first):
             yield first
             return

@@ -120,8 +120,11 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 args = (body.decode("utf-8", "replace"),)
         try:
-            ref, replica = self._router.assign_request_with_replica(name, *args)
-            result = ray_tpu.get(ref, timeout=60)
+            # failover path: a replica dying mid-request costs one retry on
+            # a healthy replica, not a user-visible 500
+            result, replica = self._router.call_with_failover(
+                name, args, timeout=60
+            )
             if isinstance(result, dict) and "__serve_stream__" in result:
                 return "stream", (replica, result["__serve_stream__"])
             return "200 OK", {"result": result}

@@ -1,0 +1,11 @@
+"""Test-facing utilities shipped with the framework.
+
+``ray_tpu.testing.chaos`` is the deterministic fault-injection layer: seeded
+plans of named injections (kill a worker at the Nth leased task, sever an RPC
+connection on the Nth message, restart the GCS mid-call, ...) wired into the
+production code paths behind near-zero-cost hooks. See chaos.py.
+"""
+
+from ray_tpu.testing import chaos  # noqa: F401
+
+__all__ = ["chaos"]

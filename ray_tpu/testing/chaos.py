@@ -1,0 +1,334 @@
+"""Seeded, deterministic fault injection.
+
+Parity: the reference's chaos tests (python/ray/tests/test_chaos.py +
+``ray._private.test_utils.get_and_run_resource_killer``) randomly SIGKILL
+processes on a timer; here injection is *deterministic* instead — a plan
+names exact injection points and trigger counts, so a failure found once
+replays exactly from ``(plan, seed)``.
+
+A plan is a list of rules bound to named injection points that production
+code fires through :func:`fire` (a no-op unless a plan is active):
+
+====================  ======================================================
+point                 where it fires
+====================  ======================================================
+``rpc.send``          ``core/rpc.py`` ``Connection._send`` — the Nth
+                      matching request frame is dropped / delayed / the
+                      connection severed
+``rpc.handle``        ``core/rpc.py`` ``Connection._dispatch`` — after the
+                      handler ran, before the response frame: the serving
+                      process can exit mid-call (GCS restart injection) or
+                      swallow/delay the reply
+``worker.lease``      ``core/raylet/worker_pool.py`` — the worker granted
+                      the Nth lease is SIGKILLed
+``actor.call``        actor-task execution (``worker_main`` /
+                      ``local_backend``) — the actor's process "dies" at the
+                      Nth matching call
+``cgraph.iter``       ``cgraph/executor.py`` ``node_loop`` — a compiled
+                      graph participant dies at the Nth loop iteration
+====================  ======================================================
+
+Usage (context-manager API)::
+
+    from ray_tpu.testing import chaos
+
+    with chaos.plan(seed=7).kill_worker(after_tasks=3).sever_rpc("kv_put"):
+        ray_tpu.init(...)          # daemons inherit the plan via env var
+        ...                        # injections fire deterministically
+    plan.events()                  # every injection, cluster-wide
+
+Activation propagates two ways: in-process via a module global (local mode,
+the driver), and through ``RAY_TPU_CHAOS_PLAN`` (JSON) in the environment so
+cluster daemons and workers spawned *inside* the ``with`` block pick the plan
+up at startup. Every firing appends a JSON line to ``RAY_TPU_CHAOS_LOG``
+(shared across processes; O_APPEND) and logs a ``CHAOS`` warning, so a run
+is auditable and replayable from the seed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_PLAN = "RAY_TPU_CHAOS_PLAN"
+ENV_LOG = "RAY_TPU_CHAOS_LOG"
+
+
+class ChaosKilled(BaseException):
+    """Raised on the thread of a chaos-killed in-process actor to unwind it.
+
+    BaseException so user-level ``except Exception`` can't swallow a death
+    the plan asked for (matching a real SIGKILL, which no handler sees).
+    """
+
+
+class ChaosPlan:
+    """Builder + context manager for one deterministic injection plan."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[Dict[str, Any]] = []
+        self._log_path: Optional[str] = None
+        self._saved_env: Dict[str, Optional[str]] = {}
+
+    # ------------------------------------------------------------- builders
+    def _rule(self, point: str, action: str, *, match: str = "", nth: int = 1,
+              repeat: bool = False, **extra) -> "ChaosPlan":
+        r = {"point": point, "action": action, "match": match,
+             "nth": max(1, int(nth)), "repeat": bool(repeat)}
+        r.update(extra)
+        self.rules.append(r)
+        return self
+
+    def kill_worker(self, after_tasks: int = 1) -> "ChaosPlan":
+        """SIGKILL the worker granted the Nth task lease on a raylet."""
+        return self._rule("worker.lease", "kill", nth=after_tasks)
+
+    def kill_actor(self, match: str = "", after_calls: int = 1) -> "ChaosPlan":
+        """Kill the actor process at the Nth call whose ``Class.method``
+        contains ``match`` (empty = any actor call)."""
+        return self._rule("actor.call", "kill", match=match, nth=after_calls)
+
+    def kill_cgraph_actor(self, match: str = "",
+                          after_iters: int = 1) -> "ChaosPlan":
+        """Kill a compiled-graph participant at the Nth execution-loop
+        iteration whose node methods contain ``match``."""
+        return self._rule("cgraph.iter", "kill", match=match, nth=after_iters)
+
+    def drop_rpc(self, method: str, nth: int = 1) -> "ChaosPlan":
+        """Silently drop the Nth outbound request frame for ``method``."""
+        return self._rule("rpc.send", "drop", match=method, nth=nth)
+
+    def delay_rpc(self, method: str, nth: int = 1,
+                  delay_s: Optional[float] = None,
+                  repeat: bool = False) -> "ChaosPlan":
+        """Delay the Nth outbound ``method`` frame (seeded delay when
+        ``delay_s`` is None)."""
+        return self._rule("rpc.send", "delay", match=method, nth=nth,
+                          repeat=repeat, delay_s=delay_s)
+
+    def sever_rpc(self, method: str = "", nth: int = 1) -> "ChaosPlan":
+        """Sever the connection when the Nth matching request would send."""
+        return self._rule("rpc.send", "sever", match=method, nth=nth)
+
+    def restart_gcs(self, on_call: str = "kv_put", nth: int = 1) -> "ChaosPlan":
+        """Make the GCS process exit mid-call on the Nth ``on_call`` it
+        handles (after the handler mutated state, before the reply — the
+        caller sees a lost connection). The test harness restarts it."""
+        return self._rule("rpc.handle", "exit", match=on_call, nth=nth)
+
+    # -------------------------------------------------------- serialization
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed, "rules": self.rules})
+
+    @staticmethod
+    def from_json(s: str) -> "ChaosPlan":
+        d = json.loads(s)
+        p = ChaosPlan(d.get("seed", 0))
+        p.rules = list(d.get("rules", []))
+        return p
+
+    # ------------------------------------------------------------ lifecycle
+    def __enter__(self) -> "ChaosPlan":
+        self._log_path = os.environ.get(ENV_LOG) or os.path.join(
+            "/tmp", f"ray_tpu_chaos_{os.getpid()}_{uuid.uuid4().hex[:6]}.jsonl"
+        )
+        for key, val in ((ENV_PLAN, self.to_json()), (ENV_LOG, self._log_path)):
+            self._saved_env[key] = os.environ.get(key)
+            os.environ[key] = val
+        install(self)
+        return self
+
+    def __exit__(self, *exc_info):
+        uninstall()
+        for key, prev in self._saved_env.items():
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        self._saved_env.clear()
+        return False
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Every injection fired so far, across all processes (driver,
+        daemons, workers), in firing order."""
+        if not self._log_path:
+            return []
+        out = []
+        try:
+            with open(self._log_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            out.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            pass
+        except OSError:
+            pass
+        return out
+
+
+def plan(seed: int = 0) -> ChaosPlan:
+    """Start building a seeded chaos plan: ``chaos.plan(7).kill_worker(...)``."""
+    return ChaosPlan(seed)
+
+
+class _Runtime:
+    """Per-process execution state of an active plan: deterministic rule
+    counters + the injection log."""
+
+    def __init__(self, cplan: ChaosPlan):
+        self.plan = cplan
+        self.counters = [0] * len(cplan.rules)
+        self.fired = [0] * len(cplan.rules)
+        self.rng = random.Random(cplan.seed)
+        self.lock = threading.Lock()
+        self.log_path = os.environ.get(ENV_LOG)
+        self.events: List[Dict[str, Any]] = []  # this process's firings
+
+    def fire(self, point: str, key: str = "") -> Optional[Dict[str, Any]]:
+        action = None
+        with self.lock:
+            for i, r in enumerate(self.plan.rules):
+                if r["point"] != point:
+                    continue
+                if r.get("match") and r["match"] not in key:
+                    continue
+                if self.fired[i] and not r.get("repeat"):
+                    continue  # one-shot rule already spent
+                self.counters[i] += 1
+                nth = r.get("nth", 1)
+                # one-shot uses >= so a rule whose trigger event was consumed
+                # by ANOTHER rule firing first still fires on the next match
+                # instead of being starved forever
+                trigger = (
+                    self.counters[i] % nth == 0
+                    if r.get("repeat") else self.counters[i] >= nth
+                )
+                if trigger and action is None:
+                    self.fired[i] += 1
+                    action = dict(r)
+                    if action["action"] == "delay" and not action.get("delay_s"):
+                        action["delay_s"] = round(
+                            0.05 + 0.2 * self.rng.random(), 3
+                        )
+                    self._log(point, key, i, action)
+        return action
+
+    def _log(self, point: str, key: str, rule_index: int, action: dict):
+        event = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "seed": self.plan.seed,
+            "point": point,
+            "key": key,
+            "rule": rule_index,
+            "action": action["action"],
+            "count": self.counters[rule_index],
+        }
+        self.events.append(event)
+        logger.warning(
+            "CHAOS[seed=%d] %s at %s key=%r (rule %d, count %d)",
+            self.plan.seed, action["action"], point, key, rule_index,
+            self.counters[rule_index],
+        )
+        if self.log_path:
+            try:
+                with open(self.log_path, "a") as f:
+                    f.write(json.dumps(event) + "\n")
+            except OSError:
+                pass
+
+
+_active: Optional[_Runtime] = None
+_env_checked = False
+_exit_callback: Optional[Callable[[], None]] = None
+_local_actor_killer: Optional[Callable[[str], bool]] = None
+
+
+def install(cplan: ChaosPlan) -> None:
+    global _active
+    _active = _Runtime(cplan)
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[_Runtime]:
+    """The active runtime, lazily loading ``RAY_TPU_CHAOS_PLAN`` once in
+    subprocesses that inherited a plan through the environment."""
+    global _active, _env_checked
+    if _active is not None:
+        return _active
+    if not _env_checked:
+        _env_checked = True
+        raw = os.environ.get(ENV_PLAN)
+        if raw:
+            try:
+                _active = _Runtime(ChaosPlan.from_json(raw))
+                logger.warning("chaos plan loaded from environment: %s", raw)
+            except Exception:  # noqa: BLE001 - malformed plan must not kill us
+                logger.exception("invalid %s; chaos disabled", ENV_PLAN)
+    return _active
+
+
+def fire(point: str, key: str = "") -> Optional[Dict[str, Any]]:
+    """Production-code hook: returns the triggered rule's action dict (the
+    caller performs/delegates it) or None. Near-zero cost when no plan is
+    active."""
+    rt = _active if _active is not None else active()
+    if rt is None:
+        return None
+    return rt.fire(point, key)
+
+
+# ------------------------------------------------------------ action helpers
+def set_exit_callback(cb: Optional[Callable[[], None]]) -> None:
+    """Register a pre-exit hook for the ``exit`` action (the GCS registers
+    its synchronous snapshot write here, so a chaos crash is a crash *after*
+    durability — the same window the old sleep-and-kill tests approximated)."""
+    global _exit_callback
+    _exit_callback = cb
+
+
+def perform_exit(reason: str = "") -> None:
+    """Kill this process mid-call (``exit`` action)."""
+    logger.warning("CHAOS: exiting process (%s)", reason)
+    cb = _exit_callback
+    try:
+        if cb is not None:
+            cb()
+    finally:
+        os._exit(1)
+
+
+def set_local_actor_killer(fn: Optional[Callable[[str], bool]]) -> None:
+    """Local-mode backend registers how to 'kill' the actor running on the
+    current thread (process-kill semantics without a process)."""
+    global _local_actor_killer
+    _local_actor_killer = fn
+
+
+def perform_kill_self(reason: str = "chaos kill") -> None:
+    """Die as the currently-executing actor. Cluster workers take a real
+    SIGKILL; local-mode actors fail through the backend and unwind via
+    ChaosKilled."""
+    if os.environ.get("RAY_TPU_STARTUP_TOKEN") is not None:
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+    killer = _local_actor_killer
+    if killer is not None:
+        killer(reason)
+    raise ChaosKilled(reason)

@@ -170,15 +170,34 @@ class DataParallelTrainer(BaseTrainer):
         the reference surfaces to Tune) with the other ranks' metrics for the
         same report index attached under "_all_ranks"."""
         import ray_tpu
+        from ray_tpu import exceptions as exc
+        from ray_tpu.core.config import _config
 
         done = [False] * group.num_workers
         self._last_checkpoint = None
         per_rank: List[List[Dict[str, Any]]] = [[] for _ in range(group.num_workers)]
         emitted = 0
         while not all(done):
-            events = ray_tpu.get(
-                [w.poll.remote(1.0) for w in group.workers], timeout=600
-            )
+            try:
+                events = ray_tpu.get(
+                    [w.poll.remote(1.0) for w in group.workers],
+                    timeout=_config.train_poll_timeout_s,
+                )
+            except exc.ActorError:
+                raise  # already a typed worker-death error
+            except exc.GetTimeoutError:
+                # a slow round OR a wedged/dead worker: probe liveness so a
+                # death surfaces typed instead of as an opaque timeout
+                group.check_alive()
+                raise
+            except exc.RayTpuError as e:
+                # raw RPC/submission failure: if a worker is gone, surface
+                # THAT (check_alive raises ActorDiedError); otherwise wrap
+                # as a worker-crash so FailureConfig still catches it
+                group.check_alive()
+                raise exc.WorkerCrashedError(
+                    f"train worker poll failed: {e}"
+                ) from e
             for rank, evs in enumerate(events):
                 for kind, metrics, ckpt in evs:
                     if kind == "done":

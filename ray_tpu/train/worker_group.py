@@ -170,6 +170,24 @@ class WorkerGroup:
         ]
         return ray_tpu.get(refs, timeout=timeout)
 
+    def check_alive(self) -> None:
+        """Raise a typed worker-death error if any worker actor is gone.
+
+        The trainer's drive loop calls this when a poll round fails or
+        times out, so a worker death surfaces as a catchable
+        ActorDiedError into the FailureConfig retry loop — never as a bare
+        hang or a raw RPC error string."""
+        from ray_tpu.api import _global_worker
+
+        backend = _global_worker().backend
+        for rank, w in enumerate(self.workers):
+            state = backend.actor_state(w._actor_id)
+            if state == "DEAD":
+                raise ray_tpu.exceptions.ActorDiedError(
+                    w._actor_id,
+                    f"train worker rank {rank} died mid-run",
+                )
+
     def rendezvous(self, attempts: int = 3):
         """jax.distributed bootstrap across the group (no-op for 1 worker).
 

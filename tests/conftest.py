@@ -20,6 +20,8 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import signal
+
 import pytest
 
 
@@ -27,6 +29,40 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from the tier-1 run"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos(timeout=120): deterministic fault-injection tests "
+        "(ray_tpu.testing.chaos). Run in tier-1 under a per-test SIGALRM "
+        "guard so a regression that re-introduces a hang fails fast "
+        "instead of stalling the whole suite.",
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Per-test timeout guard for chaos-marked tests: fault-injection bugs
+    typically manifest as hangs (a blocked get on a dead ring), and the
+    suite-level timeout would eat the whole tier-1 budget. SIGALRM fires in
+    the main thread; the framework's blocking waits are sleep-loops, so the
+    alarm interrupts them."""
+    marker = item.get_closest_marker("chaos")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    limit = int(marker.kwargs.get("timeout", 120))
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded its {limit}s guard (stuck failure path?)"
+        )
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(limit)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture

@@ -1,0 +1,455 @@
+"""Deterministic chaos-injection tests (ray_tpu/testing/chaos.py).
+
+The acceptance triangle of the robustness PR, all driven by seeded plans:
+  1. compiled graphs: a mid-pipeline participant death either fails fast
+     (ActorDiedError well before the ring timeout, max_restarts=0) or
+     recovers (dag.recover() / auto_recover=True, max_restarts=-1);
+  2. serve: a replica dying mid-request costs exactly one retry on a
+     healthy replica, never a user-visible error;
+  3. core FT regression: task retry + lineage reconstruction + actor
+     restart under seeded worker kills, replacing ad-hoc sleep-and-kill.
+
+Every test is tier-1 (fast, deterministic) and chaos-marked, so conftest's
+SIGALRM guard fails a re-introduced hang quickly instead of stalling the
+suite.
+"""
+
+import os
+import time
+
+import pytest
+
+
+# --------------------------------------------------------------------------
+# plan mechanics (no runtime needed)
+# --------------------------------------------------------------------------
+def test_plan_roundtrip_env_and_event_log():
+    from ray_tpu.testing import chaos
+
+    p = chaos.plan(7).kill_worker(after_tasks=3).sever_rpc("kv_put", nth=2)
+    clone = chaos.ChaosPlan.from_json(p.to_json())
+    assert clone.seed == 7 and clone.rules == p.rules
+
+    with p:
+        assert os.environ[chaos.ENV_PLAN] == p.to_json()
+        # deterministic counters: 3rd lease fires, then the rule is spent
+        assert chaos.fire("worker.lease") is None
+        assert chaos.fire("worker.lease") is None
+        act = chaos.fire("worker.lease")
+        assert act is not None and act["action"] == "kill"
+        assert chaos.fire("worker.lease") is None
+        # match filters by substring; nth counts matching events only
+        assert chaos.fire("rpc.send", key="kv_get") is None
+        assert chaos.fire("rpc.send", key="kv_put") is None
+        assert chaos.fire("rpc.send", key="kv_put")["action"] == "sever"
+    assert chaos.ENV_PLAN not in os.environ
+
+    events = p.events()
+    assert [e["point"] for e in events] == ["worker.lease", "rpc.send"]
+    assert all(e["seed"] == 7 for e in events)
+    assert [e["action"] for e in events] == ["kill", "sever"]
+
+
+def test_overlapping_rules_are_not_starved():
+    """Two rules matching the same event: one fires, the other must fire on
+    the NEXT matching event instead of being counted past its trigger."""
+    from ray_tpu.testing import chaos
+
+    p = (chaos.plan(0)
+         .kill_actor(match="A", after_calls=1)
+         .kill_actor(match="A.b", after_calls=1))
+    with p:
+        assert chaos.fire("actor.call", key="A.b") is not None  # rule 0 wins
+        assert chaos.fire("actor.call", key="A.b") is not None  # rule 1 fires
+        assert chaos.fire("actor.call", key="A.b") is None      # both spent
+    assert len(p.events()) == 2
+
+
+def test_rpc_sever_injection_deterministic():
+    """The rpc.send hook: the Nth matching frame severs the connection."""
+    import pytest as _pytest
+
+    from ray_tpu.core import rpc
+    from ray_tpu.testing import chaos
+
+    class Handler:
+        def handle_echo(self, conn, x):
+            return x * 2
+
+    io = rpc.EventLoopThread(name="chaos-rpc-test")
+    try:
+        server = rpc.RpcServer(Handler())
+        io.run(server.start())
+        with chaos.plan(1).sever_rpc("echo", nth=2) as p:
+            conn = io.run(rpc.connect(server.address, name="chaos-test"))
+            assert io.run(conn.call("echo", x=3, timeout=10)) == 6
+            with _pytest.raises(rpc.RpcError):
+                io.run(conn.call("echo", x=4, timeout=10))
+            assert [e["action"] for e in p.events()] == ["sever"]
+        io.run(server.close())
+    finally:
+        io.stop()
+
+
+# --------------------------------------------------------------------------
+# compiled-graph fault tolerance (local mode, tier-1)
+# --------------------------------------------------------------------------
+def _make_stages(ray_tpu, **actor_opts):
+    dec = ray_tpu.remote(**actor_opts) if actor_opts else ray_tpu.remote
+
+    @dec
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def head(self, x):
+            return x + self.k
+
+        def mid(self, x):
+            return x + self.k
+
+        def tail(self, x):
+            return x + self.k
+
+    return Stage.remote(1), Stage.remote(10), Stage.remote(100)
+
+
+def _compile_chain(ray_tpu, a, b, c, **kw):
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = c.tail.bind(b.mid.bind(a.head.bind(inp)))
+    return dag.experimental_compile(max_in_flight=4, **kw)
+
+
+@pytest.mark.chaos(timeout=90)
+def test_cgraph_dead_participant_fails_fast(ray_start_local):
+    """max_restarts=0: a killed mid-pipeline actor surfaces as
+    ActorDiedError from ref.get() well before the caller's timeout."""
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    a, b, c = _make_stages(ray_tpu)
+    compiled = _compile_chain(ray_tpu, a, b, c)
+    try:
+        assert compiled.execute(0).get(timeout=10) == 111
+        time.sleep(0.2)  # let the loops settle on their blocking reads
+        with chaos.plan(3).kill_cgraph_actor(match="mid", after_iters=1) as p:
+            r1 = compiled.execute(1, timeout=10)
+            r2 = compiled.execute(2, timeout=10)
+            # seq 1 completes (the kill lands on b's NEXT iteration)...
+            assert r1.get(timeout=30) == 112
+            # ...seq 2 is lost mid-pipeline: prompt typed error, not a
+            # 60s ring-timeout burn
+            t0 = time.monotonic()
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+                r2.get(timeout=60)
+            assert time.monotonic() - t0 < 15
+            assert [e["action"] for e in p.events()] == ["kill"]
+        # the dead participant also fails new submissions fast
+        with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+            compiled.execute(3, timeout=10)
+    finally:
+        compiled.teardown()
+
+
+@pytest.mark.chaos(timeout=90)
+def test_cgraph_recover_manual(ray_start_local):
+    """max_restarts=-1 + dag.recover(): in-flight seq fails with a precise
+    per-seq error; the recovered graph resumes at the next seq."""
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    a, b, c = _make_stages(ray_tpu, max_restarts=-1)
+    compiled = _compile_chain(ray_tpu, a, b, c)
+    try:
+        assert compiled.execute(0).get(timeout=10) == 111
+        time.sleep(0.2)
+        with chaos.plan(5).kill_cgraph_actor(match="mid", after_iters=1) as p:
+            r1 = compiled.execute(1, timeout=10)
+            r2 = compiled.execute(2, timeout=10)
+            assert r1.get(timeout=30) == 112        # completed before the kill
+            with pytest.raises(ray_tpu.exceptions.ActorUnavailableError):
+                r2.get(timeout=30)                  # restarting: resumable
+            compiled.recover()
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError,
+                               match="seq=2"):
+                r2.get(timeout=10)                  # precise per-seq error
+            # the recovered graph computes correctly at the next seqs
+            assert compiled.execute(3, timeout=10).get(timeout=30) == 114
+            assert compiled.execute(4, timeout=10).get(timeout=30) == 115
+            assert [e["action"] for e in p.events()] == ["kill"]
+    finally:
+        compiled.teardown()
+
+
+@pytest.mark.chaos(timeout=90)
+def test_cgraph_auto_recover(ray_start_local):
+    """auto_recover=True: no manual recover() call — the in-flight seq
+    resolves with its per-seq error and execution continues."""
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    a, b, c = _make_stages(ray_tpu, max_restarts=-1)
+    compiled = _compile_chain(ray_tpu, a, b, c, auto_recover=True)
+    try:
+        assert compiled.execute(0).get(timeout=10) == 111
+        time.sleep(0.2)
+        with chaos.plan(6).kill_cgraph_actor(match="mid", after_iters=1) as p:
+            r1 = compiled.execute(1, timeout=10)
+            r2 = compiled.execute(2, timeout=10)
+            assert r1.get(timeout=30) == 112
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError,
+                               match="seq=2"):
+                r2.get(timeout=30)
+            assert compiled.execute(3, timeout=10).get(timeout=30) == 114
+            assert len(p.events()) == 1
+    finally:
+        compiled.teardown()
+
+
+def test_cgraph_result_cache_evicts_abandoned_refs(ray_start_local):
+    """ROADMAP-known leak: results for refs never get()'d must not
+    accumulate in the driver-side cache once the ref is GC'd."""
+    import gc
+
+    import ray_tpu
+
+    a, b, c = _make_stages(ray_tpu)
+    compiled = _compile_chain(ray_tpu, a, b, c)
+    try:
+        # abandon refs without ever get()ing them
+        for i in range(8):
+            compiled.execute(i, timeout=10)
+        gc.collect()
+        # a kept ref drains the output rings; abandoned seqs are evicted
+        keeper = compiled.execute(99, timeout=10)
+        assert keeper.get(timeout=30) == 210
+        assert len(compiled._results) == 0, compiled._results
+        # the abandoned-seq bookkeeping is consumed, not retained
+        assert compiled._abandoned == set()
+    finally:
+        compiled.teardown()
+
+
+# --------------------------------------------------------------------------
+# serve routing failover (local mode, tier-1)
+# --------------------------------------------------------------------------
+_SERVE_CALLS = []
+
+
+@pytest.mark.chaos(timeout=120)
+def test_serve_replica_failover_single_retry(ray_start_local):
+    """2 replicas; the one serving the request is chaos-killed mid-dispatch:
+    the request succeeds after exactly one retry on the healthy replica."""
+    import ray_tpu
+    from ray_tpu.serve import api as serve
+    from ray_tpu.testing import chaos
+
+    _SERVE_CALLS.clear()
+
+    @serve.deployment(name="frail-chaos", num_replicas=2)
+    class Frail:
+        def __call__(self, x):
+            _SERVE_CALLS.append(x)
+            return 2 * x
+
+    handle = serve.run(Frail.bind())
+    try:
+        # warm the routing table outside the plan
+        assert ray_tpu.get(handle.remote(1), timeout=60) == 2
+        with chaos.plan(11).kill_actor(
+            match="ServeReplica.handle_request", after_calls=1
+        ) as p:
+            assert ray_tpu.get(handle.remote(21), timeout=60) == 42
+            assert handle._router.retry_count == 1
+            kills = [e for e in p.events() if e["point"] == "actor.call"]
+            assert len(kills) == 1
+        # the chaos kill fired before user code: the request executed
+        # exactly once (on the healthy replica) — no double execution
+        assert _SERVE_CALLS.count(21) == 1
+        # the dead replica was evicted from the router's local set
+        assert len(handle._router._replicas["frail-chaos"]) == 1
+    finally:
+        serve.shutdown()
+
+
+# --------------------------------------------------------------------------
+# train: worker death → FailureConfig retry from the latest checkpoint
+# --------------------------------------------------------------------------
+_TRAIN_STARTS = []
+
+
+def _flaky_train_loop(config):
+    from ray_tpu import train
+
+    ckpt = train.get_checkpoint()
+    start = int(ckpt.to_dict()["step"]) if ckpt is not None else 0
+    _TRAIN_STARTS.append(start)
+    for i in range(start, config["total_steps"]):
+        train.report(
+            {"step": i + 1},
+            checkpoint=train.Checkpoint.from_dict({"step": i + 1}),
+        )
+        time.sleep(0.25)
+
+
+@pytest.mark.chaos(timeout=150)
+def test_trainer_restarts_from_checkpoint_on_worker_death(ray_start_local):
+    import ray_tpu  # noqa: F401
+    from ray_tpu.testing import chaos
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+    from ray_tpu.train.config import FailureConfig, RunConfig
+
+    _TRAIN_STARTS.clear()
+    with chaos.plan(2).kill_actor(match="TrainWorker.poll",
+                                  after_calls=2) as p:
+        trainer = DataParallelTrainer(
+            _flaky_train_loop,
+            train_loop_config={"total_steps": 6},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=1)
+            ),
+        )
+        result = trainer.fit()
+    assert result.error is None, result.error
+    # one injected death, one elastic restart FROM THE CHECKPOINT (not 0)
+    assert [e["point"] for e in p.events()] == ["actor.call"]
+    assert len(_TRAIN_STARTS) == 2, _TRAIN_STARTS
+    assert _TRAIN_STARTS[0] == 0 and _TRAIN_STARTS[1] > 0, _TRAIN_STARTS
+    assert result.metrics["step"] == 6
+
+
+# --------------------------------------------------------------------------
+# core FT regression under seeded kills (local actor restart + cluster)
+# --------------------------------------------------------------------------
+@pytest.mark.chaos(timeout=60)
+def test_actor_restart_under_seeded_kill(ray_start_local):
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    with chaos.plan(9).kill_actor(match="Counter.inc", after_calls=3) as p:
+        assert ray_tpu.get(c.inc.remote(), timeout=10) == 1
+        assert ray_tpu.get(c.inc.remote(), timeout=10) == 2
+        with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+            ray_tpu.get(c.inc.remote(), timeout=10)  # the seeded kill
+        # restarted with FRESH state (cluster restart semantics)
+        assert ray_tpu.get(c.inc.remote(), timeout=30) == 1
+        assert len(p.events()) == 1
+
+
+@pytest.mark.chaos(timeout=180)
+def test_task_retry_under_seeded_worker_lease_kill():
+    """Cluster: the worker granted the 1st lease is SIGKILLed by the plan;
+    the task retries transparently and every result is correct."""
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    ray_tpu.shutdown()
+    with chaos.plan(6).kill_worker(after_tasks=1) as p:
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+
+            @ray_tpu.remote
+            def f(x):
+                return x + 1
+
+            results = ray_tpu.get(
+                [f.remote(i) for i in range(6)], timeout=120
+            )
+            assert results == [i + 1 for i in range(6)]
+            kills = [e for e in p.events() if e["point"] == "worker.lease"]
+            assert len(kills) == 1
+        finally:
+            ray_tpu.shutdown()
+
+
+@pytest.mark.chaos(timeout=180)
+def test_lineage_reconstruction_under_seeded_worker_kill():
+    """Cluster: the producing task's first worker is chaos-killed (task
+    retry), then the stored copy is lost — the owner lineage-reconstructs."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    ray_tpu.shutdown()
+    with chaos.plan(12).kill_worker(after_tasks=1) as p:
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+
+            @ray_tpu.remote(max_retries=3)
+            def produce():
+                return np.full(1_000_000, 7.0)  # large → lives in shm
+
+            ref = produce.remote()
+            assert ray_tpu.get(ref, timeout=120)[0] == 7.0
+            assert any(e["point"] == "worker.lease" for e in p.events())
+
+            # now lose the only stored copy out from under the owner
+            from ray_tpu.api import _global_worker
+            from ray_tpu.core.object_store import shm_store
+
+            core = _global_worker().backend.core
+            path = os.path.join(
+                shm_store.session_dir(core.session), ref.id.hex()
+            )
+            assert os.path.exists(path)
+            os.unlink(path)
+
+            got = ray_tpu.get(ref, timeout=120)
+            assert got[0] == 7.0 and got.shape == (1_000_000,)
+        finally:
+            ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------------
+# cluster-mode compiled-graph recovery (real SIGKILL; excluded from tier-1)
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.chaos(timeout=300)
+def test_cgraph_recover_cluster_mode():
+    """End to end over real worker processes: a participant's worker is
+    SIGKILLed mid-pipeline, the GCS restarts the actor, and dag.recover()
+    resumes on fresh shm rings."""
+    import ray_tpu
+    from ray_tpu.testing import chaos
+
+    # the whole cluster must start INSIDE the plan: actor workers inherit
+    # their environment (and thus the plan) from the raylet, not the driver
+    ray_tpu.shutdown()
+    with chaos.plan(13).kill_cgraph_actor(match="mid", after_iters=3):
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        a, b, c = _make_stages(ray_tpu, max_restarts=-1)
+        compiled = _compile_chain(ray_tpu, a, b, c)
+        try:
+            # iters 1-2 complete; iter 3 dies mid-pipeline
+            assert compiled.execute(0).get(timeout=60) == 111
+            r1 = compiled.execute(1, timeout=30)
+            try:
+                r2 = compiled.execute(2, timeout=30)
+            except ray_tpu.exceptions.ActorUnavailableError:
+                r2 = None  # the death event beat the submission — fine
+            assert r1.get(timeout=60) == 112
+            if r2 is not None:
+                with pytest.raises(
+                    (ray_tpu.exceptions.ActorUnavailableError,
+                     ray_tpu.exceptions.ActorDiedError)
+                ):
+                    r2.get(timeout=60)
+            compiled.recover(timeout=120)
+            assert compiled.execute(3, timeout=30).get(timeout=60) == 114
+        finally:
+            compiled.teardown()
+            ray_tpu.shutdown()

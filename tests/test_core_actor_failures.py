@@ -5,8 +5,13 @@ import time
 import pytest
 
 
+@pytest.mark.chaos(timeout=60)
 def test_kill_resolves_pending_refs(ray_start_local):
+    """Deterministic chaos-plan replacement of the old sleep-then-kill
+    pattern: the actor dies exactly when it dispatches its first nap(),
+    and BOTH the in-flight and the queued call resolve as ActorDiedError."""
     ray = ray_start_local
+    from ray_tpu.testing import chaos
 
     @ray.remote
     class Slow:
@@ -15,12 +20,41 @@ def test_kill_resolves_pending_refs(ray_start_local):
             return "done"
 
     a = Slow.remote()
+    with chaos.plan(0).kill_actor(match="Slow.nap", after_calls=1) as p:
+        ref = a.nap.remote()
+        queued = a.nap.remote()  # sits in the queue behind the dying call
+        with pytest.raises(ray.exceptions.ActorDiedError):
+            ray.get(queued, timeout=5)
+        with pytest.raises(ray.exceptions.ActorDiedError):
+            ray.get(ref, timeout=5)
+        assert len(p.events()) == 1  # exactly the planned injection fired
+
+
+def test_ray_kill_resolves_pending_refs(ray_start_local):
+    """The direct ray.kill() path (LocalBackend.kill_actor → stop →
+    resolve_pending) must also error out in-flight AND queued refs —
+    deterministic via an entry event instead of a sleep."""
+    import threading
+
+    ray = ray_start_local
+    started = threading.Event()
+
+    @ray.remote
+    class Slow:
+        def nap(self):
+            started.set()
+            time.sleep(30)
+            return "done"
+
+    a = Slow.remote()
     ref = a.nap.remote()
     queued = a.nap.remote()  # sits in the queue behind the in-flight call
-    time.sleep(0.1)
+    assert started.wait(timeout=10), "nap must have started"
     ray.kill(a)
     with pytest.raises(ray.exceptions.ActorDiedError):
         ray.get(queued, timeout=5)
+    with pytest.raises(ray.exceptions.ActorDiedError):
+        ray.get(ref, timeout=5)
 
 
 def test_call_after_kill_raises(ray_start_local):

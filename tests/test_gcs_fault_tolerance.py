@@ -35,71 +35,92 @@ def _gcs_call(ray, method, **kw):
     return core.io.run(call(), timeout=60)
 
 
-def test_gcs_restart_preserves_state_and_cluster_recovers(cluster):
-    ray, c = cluster
+@pytest.mark.chaos(timeout=240)
+def test_gcs_restart_preserves_state_and_cluster_recovers():
+    """Chaos-plan version of the old sleep-until-snapshot-then-SIGKILL
+    pattern: the GCS exits MID-CALL on the 2nd kv_put it handles (after the
+    handler mutated state and the durable snapshot flushed, before the
+    reply), deterministically — no timing sleeps."""
+    import ray_tpu as ray
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.testing import chaos
 
-    # durable state: KV + a detached named actor
-    _gcs_call(ray, "kv_put", ns="test", key="alpha", value=b"42")
+    ray.shutdown()
+    plan = chaos.plan(4).restart_gcs(on_call="kv_put", nth=2)
+    with plan:
+        c = Cluster(head_node_args={"num_cpus": 2})
+        ray.init(address=c.address)
+    try:
+        # durable state: KV + a detached named actor
+        _gcs_call(ray, "kv_put", ns="test", key="alpha", value=b"42")
 
-    @ray.remote
-    class Keeper:
-        def __init__(self):
-            self.v = 7
+        @ray.remote
+        class Keeper:
+            def __init__(self):
+                self.v = 7
 
-        def get(self):
-            return self.v
+            def get(self):
+                return self.v
 
-        def bump(self):
-            self.v += 1
-            return self.v
+            def bump(self):
+                self.v += 1
+                return self.v
 
-    keeper = Keeper.options(name="keeper", lifetime="detached").remote()
-    assert ray.get(keeper.get.remote(), timeout=60) == 7
-    assert ray.get(keeper.bump.remote(), timeout=60) == 8
+        keeper = Keeper.options(name="keeper", lifetime="detached").remote()
+        assert ray.get(keeper.get.remote(), timeout=60) == 7
+        assert ray.get(keeper.bump.remote(), timeout=60) == 8
 
-    # snapshot loop runs every 1s; let it capture the actor
-    time.sleep(2.5)
+        # the 2nd kv_put crashes the GCS mid-call: beta IS applied and
+        # snapshotted, but the reply never arrives
+        with pytest.raises(Exception):
+            _gcs_call(ray, "kv_put", ns="test", key="beta", value=b"43")
+        deadline = time.time() + 30
+        while c._gcs_proc.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert c._gcs_proc.poll() is not None, "chaos exit must have fired"
+        assert [e["action"] for e in plan.events()] == ["exit"]
+        c.restart_gcs()
 
-    c.kill_gcs()
-    time.sleep(0.5)
-    c.restart_gcs()
-
-    # driver + raylet watchdogs re-register within a few seconds
-    deadline = time.time() + 30
-    nodes = []
-    while time.time() < deadline:
-        try:
-            nodes = [n for n in ray.nodes() if n["Alive"]]
-            if nodes:
-                break
-        except Exception:  # noqa: BLE001 - reconnect in progress
-            pass
-        time.sleep(0.5)
-    assert nodes, "raylet must re-register with the restarted GCS"
-
-    # durable KV survived
-    assert _gcs_call(ray, "kv_get", ns="test", key="alpha") == b"42"
-
-    # the detached actor is still resolvable by name, and because its worker
-    # never died the raylet ADOPTS the live instance (state intact: 8), no
-    # duplicate spawn
-    deadline = time.time() + 60
-    value = None
-    while time.time() < deadline:
-        try:
-            h = ray.get_actor("keeper")
-            value = ray.get(h.get.remote(), timeout=30)
-            break
-        except Exception:  # noqa: BLE001 - still rescheduling
+        # driver + raylet watchdogs re-register within a few seconds
+        deadline = time.time() + 30
+        nodes = []
+        while time.time() < deadline:
+            try:
+                nodes = [n for n in ray.nodes() if n["Alive"]]
+                if nodes:
+                    break
+            except Exception:  # noqa: BLE001 - reconnect in progress
+                pass
             time.sleep(0.5)
-    assert value == 8, f"live detached actor must be adopted, got {value!r}"
+        assert nodes, "raylet must re-register with the restarted GCS"
 
-    # and the cluster still runs fresh work end-to-end
-    @ray.remote
-    def f(x):
-        return x * 3
+        # durable KV survived — INCLUDING the mutation of the crashed call
+        assert _gcs_call(ray, "kv_get", ns="test", key="alpha") == b"42"
+        assert _gcs_call(ray, "kv_get", ns="test", key="beta") == b"43"
 
-    assert ray.get(f.remote(5), timeout=60) == 15
+        # the detached actor is still resolvable by name, and because its
+        # worker never died the raylet ADOPTS the live instance (state
+        # intact: 8), no duplicate spawn
+        deadline = time.time() + 60
+        value = None
+        while time.time() < deadline:
+            try:
+                h = ray.get_actor("keeper")
+                value = ray.get(h.get.remote(), timeout=30)
+                break
+            except Exception:  # noqa: BLE001 - still rescheduling
+                time.sleep(0.5)
+        assert value == 8, f"live detached actor must be adopted, got {value!r}"
+
+        # and the cluster still runs fresh work end-to-end
+        @ray.remote
+        def f(x):
+            return x * 3
+
+        assert ray.get(f.remote(5), timeout=60) == 15
+    finally:
+        ray.shutdown()
+        c.shutdown()
 
 
 def test_gcs_two_restart_cycles(cluster):
