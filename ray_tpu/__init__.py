@@ -26,6 +26,7 @@ from ray_tpu.api import (
 )
 from ray_tpu.core.config import _config
 from ray_tpu.core.refs import ObjectRef
+from ray_tpu.streaming import ObjectRefGenerator
 from ray_tpu import exceptions
 
 __all__ = [
@@ -46,5 +47,6 @@ __all__ = [
     "nodes",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "exceptions",
 ]

@@ -20,7 +20,7 @@ CGRAPH_CALL_METHOD = "__ray_tpu_call__"
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns=1):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
@@ -50,6 +50,11 @@ class ActorMethod:
         call_opts.setdefault("num_returns", self._num_returns)
         opts = self._handle._options.merged_with(**call_opts)
         backend = _global_worker().backend
+        if opts.num_returns == "streaming":
+            # backend returns an ObjectRefGenerator (push-based per-item refs)
+            return backend.submit_actor_task(
+                self._handle._actor_id, self._method_name, args, kwargs, opts
+            )
         refs = backend.submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs, opts
         )
@@ -154,8 +159,10 @@ class ActorClass:
         return ClassNode(self, args, kwargs)
 
 
-def method(num_returns: int = 1):
-    """Decorator to annotate actor methods (reference: ray.method)."""
+def method(num_returns=1):
+    """Decorator to annotate actor methods (reference: ray.method).
+    ``num_returns`` accepts an int or ``"streaming"`` for generator methods
+    whose calls return an ObjectRefGenerator."""
 
     def decorator(f):
         f.__ray_tpu_num_returns__ = num_returns

@@ -25,7 +25,13 @@ class Backend(abc.ABC):
     def submit_task(
         self, func, args: tuple, kwargs: dict, options: RemoteOptions
     ) -> Sequence[ObjectRef]:
-        """Submit a stateless task; returns one ref per return value."""
+        """Submit a stateless task; returns one ref per return value.
+
+        With ``options.num_returns == "streaming"`` the function must be a
+        generator and the backend returns an
+        :class:`ray_tpu.streaming.ObjectRefGenerator` instead — each
+        yielded item is pushed to the caller as its own object the moment
+        it is produced (same contract for submit_actor_task)."""
 
     @abc.abstractmethod
     def create_actor(
@@ -116,5 +122,8 @@ class Backend(abc.ABC):
         """Allocate a driver-owned ObjectRef fulfilled later by framework
         code: returns ``(ref, fulfill)`` where ``fulfill(value=..)`` /
         ``fulfill(error=..)`` resolves it, or None when unsupported (serve
-        uses this to retry a request behind one stable user-facing ref)."""
+        uses this to retry a request behind one stable user-facing ref).
+        Backends that also expose ``as_serialized_future(ref)`` accept
+        ``fulfill(serialized=bytes)`` so relays can pass a response through
+        without deserializing + re-serializing it."""
         return None

@@ -364,7 +364,10 @@ class ClusterBackend(Backend):
         core._own(oid)
         ref = ObjectRef(oid, owner_addr=core.address)
 
-        def fulfill(value=None, error=None):
+        def fulfill(value=None, error=None, serialized=None):
+            """serialized: already-serialized bytes pass straight into the
+            driver store — the serve failover chain uses this so the success
+            path never deserializes + re-serializes the replica's response."""
             if error is not None:
                 err = (
                     error if isinstance(error, exc.RayTpuError)
@@ -372,13 +375,42 @@ class ClusterBackend(Backend):
                 )
                 core.memory_store.put_error(oid, err)
                 return
-            data = serialization.serialize(value).to_bytes()
+            if serialized is not None:
+                data = (
+                    serialized if isinstance(serialized, bytes)
+                    else bytes(serialized)
+                )
+            else:
+                data = serialization.serialize(value).to_bytes()
             if len(data) <= _config.max_direct_call_object_size:
                 core.memory_store.put_value(oid, data)
             else:
                 core._put_shm(oid, data)
 
         return ref, fulfill
+
+    def as_serialized_future(self, ref: ObjectRef):
+        """Future resolving to the object's SERIALIZED bytes (exceptions are
+        set as exceptions, task errors as their user-facing cause). Pairs
+        with create_deferred's fulfill(serialized=...) so framework relays
+        (serve failover) can pass bytes through without a decode/encode."""
+        out: concurrent.futures.Future = concurrent.futures.Future()
+
+        async def resolve():
+            try:
+                data = await self.core._fetch_serialized(ref, None)
+                if isinstance(data, BaseException):
+                    e = data
+                    if isinstance(e, exc.TaskError):
+                        e = e.as_instanceof_cause()
+                    out.set_exception(e)
+                else:
+                    out.set_result(data)
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        self.core.io.spawn(resolve())
+        return out
 
     def free_actor(self, actor_id):
         # fire-and-forget: this runs from ActorHandle.__del__, which GC may

@@ -82,6 +82,16 @@ class Config:
     # serve: retries of a request whose replica died mid-flight (each retry
     # routes to a different, healthy replica)
     serve_request_retries: int = 1
+    # serve: default per-request timeout for handle/proxy dispatch and
+    # per-chunk stream waits (overridable per deployment via
+    # request_timeout_s and per handle via DeploymentHandle.options)
+    serve_request_timeout_s: float = 60.0
+
+    # --- streaming generators ----------------------------------------------
+    # un-acked stream_item pushes a producing worker keeps in flight when no
+    # explicit generator_backpressure_num_objects is set (bounds owner-side
+    # buffering without serializing the push pipeline)
+    streaming_max_inflight_items: int = 64
     # train: per-round driver wait on worker polls before probing liveness
     train_poll_timeout_s: float = 120.0
 

@@ -179,6 +179,9 @@ class CoreWorker:
         self._packed_envs: Dict[str, dict] = {}
         self._actor_addr_cache: Dict[bytes, str] = {}
         self._actor_queues: Dict[bytes, "_ActorSubmitState"] = {}
+        # live streaming generators owned by this process, by task_id bytes
+        # (workers push items into handle_stream_item; consumers iterate)
+        self._streams: Dict[bytes, Any] = {}
         self._actor_conns: Dict[str, rpc.Connection] = {}
         self._worker_conns: Dict[str, rpc.Connection] = {}
         self._raylet_conns: Dict[str, rpc.Connection] = {}
@@ -378,6 +381,88 @@ class CoreWorker:
     def handle_ping(self, conn):
         return "pong"
 
+    # ------------------------------------------------- streaming generators
+    # Owner side of the push protocol (ray_tpu/streaming/): the executing
+    # worker reports each yielded item over the task's own connection the
+    # moment it is produced — small values inline, large ones as a shm
+    # location (the bytes ride the node object store / transfer plane, not
+    # this RPC). With a backpressure window the response is withheld until
+    # the consumer drains (the worker blocks in `yield` awaiting it).
+
+    def _make_stream(self, task_id: TaskID, window, name: str):
+        from ray_tpu.streaming import StreamState
+
+        # no explicit window still bounds owner-side buffering: sync-point
+        # replies (every sync carries this credit check) are withheld once
+        # the producer runs streaming_max_inflight_items ahead, so a slow
+        # consumer never materializes the whole stream in our memory store
+        window = window or max(1, _config.streaming_max_inflight_items)
+        state = StreamState(
+            task_id, owner_addr=self.address, window=window, name=name
+        )
+        state.set_on_close(self._close_stream)
+        self._streams[task_id.binary()] = state
+        return state
+
+    def _close_stream(self, state) -> None:
+        """Consumer closed/abandoned the generator: forget the stream and
+        reclaim item objects it never claimed (claimed items free through
+        normal ref counting). Reclaim goes through _maybe_free so shm
+        copies free on the raylets and borrows granted through an item
+        release at their owners."""
+        self._streams.pop(state.task_id.binary(), None)
+
+        def _gc():
+            for i in range(state.consumed, state.count):
+                oid = ObjectID.for_task_return(state.task_id, i)
+                self.memory_store.delete(oid)
+                self._maybe_free(oid.binary())
+
+        try:
+            self.io.loop.call_soon_threadsafe(_gc)
+        except RuntimeError:  # loop already closed (shutdown)
+            pass
+
+    def _fail_stream(self, spec, error: BaseException) -> bool:
+        """Fail the stream of a streaming spec (producer death / submission
+        failure); no-op for ordinary tasks. Returns True when handled."""
+        if not getattr(spec, "streaming", False):
+            return False
+        state = self._streams.get(spec.task_id.binary())
+        if state is not None:
+            state.fail(error)
+        self._unpin_task_args(spec.task_id)
+        self._record_task_event(spec, "FAILED")
+        return True
+
+    async def handle_stream_item(self, conn, task_id_hex, index, kind,
+                                 payload, sync=True):
+        """A producing worker pushed stream item `index`. Store it, wake the
+        consumer, and — on sync pushes (requests the producer awaits; one-way
+        notifies pass sync=False) — hold the reply until the item is inside
+        the consumer's window, blocking the producer in `yield`."""
+        key = bytes.fromhex(task_id_hex)
+        state = self._streams.get(key)
+        if state is None or state.closed:
+            return {"closed": True}  # producer stops early
+        oid = ObjectID.for_task_return(TaskID(key), index)
+        self._own(oid)
+        if kind == "inline":
+            self.memory_store.put_value(oid, payload)
+        elif kind == "location":
+            self.locations[oid] = payload
+            self.memory_store.put_value(oid, None)  # shm-location marker
+        else:  # "error": the exact item whose production raised
+            self.memory_store.put_error(oid, cloudpickle.loads(payload))
+        state.report_item(index, failed=(kind == "error"))
+        if sync:
+            # await credit without parking a thread: the consumer's
+            # next_index (or close/fail) resolves the future
+            await state.credit_event(index + 1)
+            if state.closed:
+                return {"closed": True}
+        return {"consumed": state.consumed}
+
     # ------------------------------------------------------------- put/get
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.worker_id)
@@ -408,6 +493,29 @@ class CoreWorker:
             pass
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        # Fast path: every ref already resolved INLINE in our memory store →
+        # decode on the calling thread, skipping the io-loop round trip
+        # (~0.5ms each under load). This is the hot shape of streaming
+        # consumers (items were pushed before the consumer asked) and of
+        # repeated gets on small ready results. Reading the store dict off
+        # the loop thread is GIL-safe; entries are immutable once written.
+        entries = []
+        for r in refs:
+            entry = self.memory_store.peek(r.id)
+            if entry is None or (entry[0] == "val" and entry[1] is None):
+                break  # missing, or a shm-location marker: slow path
+            entries.append(entry)
+        else:
+            out = []
+            for kind, payload in entries:
+                if kind == "err":
+                    raise (
+                        payload.as_instanceof_cause()
+                        if isinstance(payload, exc.TaskError)
+                        else payload
+                    )
+                out.append(serialization.loads(payload))
+            return out
         return self.io.run(
             self._get_async(list(refs), timeout),
             timeout=None if timeout is None else timeout + 30,
@@ -704,13 +812,14 @@ class CoreWorker:
         task_id = TaskID.from_random()
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, self.put)
         pg_id, pg_index = _pg_fields(options)
+        streaming = options.num_returns == "streaming"
         spec = ts.TaskSpec(
             task_id=task_id,
             name=getattr(func, "__name__", "task"),
             fn_id=fn_id,
             args=enc_args,
             kwargs=enc_kwargs,
-            num_returns=max(1, options.num_returns),
+            num_returns=0 if streaming else max(1, options.num_returns),
             resources=options.task_resources(),
             owner_addr=self.address,
             max_retries=(
@@ -723,15 +832,54 @@ class CoreWorker:
             placement_group_id=pg_id,
             placement_group_bundle_index=pg_index,
             runtime_env=self._pack_runtime_env(options),
+            streaming=streaming,
+            backpressure=options.generator_backpressure_num_objects,
         )
         self.submitted_specs[task_id] = spec
+        self._pin_task_args(task_id, enc_args, enc_kwargs)
+        self._record_task_event(spec, "SUBMITTED")
+        if streaming:
+            from ray_tpu.streaming import ObjectRefGenerator
+
+            state = self._make_stream(task_id, spec.backpressure, spec.name)
+            self.io.spawn(self._submit_stream_and_track(spec, state))
+            return ObjectRefGenerator(state)
         refs = spec.return_refs()
         for r in refs:
             self._own(r.id, task_id)
-        self._pin_task_args(task_id, enc_args, enc_kwargs)
-        self._record_task_event(spec, "SUBMITTED")
         self.io.spawn(self._submit_and_track(spec, refs))
         return refs
+
+    async def _submit_stream_and_track(self, spec: ts.TaskSpec, state):
+        """Streaming twin of _submit_and_track. A worker crash retries only
+        while nothing has been produced yet (items may already have been
+        consumed — a silent re-run would replay them); afterwards the stream
+        fails with the typed error and the consumer's next item raises."""
+        attempts = 0
+        while True:
+            try:
+                result = await self._submit_once(spec)
+                self._store_task_result(spec, [], result)
+                return
+            except exc.WorkerCrashedError as e:
+                if state.count == 0 and not state.closed:
+                    attempts += 1
+                    if attempts <= spec.max_retries:
+                        logger.warning(
+                            "streaming task %s worker crashed before first "
+                            "item; retry %d", spec.name, attempts,
+                        )
+                        continue
+                self._fail_stream(spec, e)
+                return
+            except exc.RayTpuError as e:
+                self._fail_stream(spec, e)
+                return
+            except Exception as e:  # noqa: BLE001 - protocol failure
+                self._fail_stream(
+                    spec, exc.RayTpuError(f"stream submission failed: {e!r}")
+                )
+                return
 
     async def _submit_and_track(self, spec: ts.TaskSpec, refs: List[ObjectRef]):
         attempts = 0
@@ -1024,8 +1172,19 @@ class CoreWorker:
         return node["address"] if node else None
 
     def _store_task_result(self, spec, refs, result: dict):
-        """result: {"results": [(kind, payload), ...]} kind: inline|location|error"""
+        """result: {"results": [(kind, payload), ...]}
+        kind: inline|location|error, or streamed (generator completion: the
+        items were already pushed via handle_stream_item; the entry carries
+        the final count so the consumer sees a typed end-of-stream)."""
         entries = result["results"]
+        if getattr(spec, "streaming", False):
+            state = self._streams.get(spec.task_id.binary())
+            for kind, payload in entries:
+                if kind == "streamed" and state is not None:
+                    state.finish(payload["total"])
+                elif kind == "error" and state is not None:
+                    state.fail(cloudpickle.loads(payload))
+            entries = [e for e in entries if e[0] not in ("streamed",)]
         for ref, (kind, payload) in zip(refs, entries):
             if kind == "inline":
                 self.memory_store.put_value(ref.id, payload)
@@ -1043,24 +1202,51 @@ class CoreWorker:
         # refs nested in the result: the worker pre-registered us as borrower
         # with each owner. Pin each to this task's return oids — we release
         # when the outer value is freed (or when a deserialized inner ref's
-        # last local copy dies after that), see _maybe_free.
+        # last local copy dies after that), see _maybe_free. Streaming
+        # grants arrive as (oid_hex, owner, item_index) triples and pin to
+        # the ITEM's oid instead; an item already freed (consumed + ref
+        # dropped mid-stream, or reclaimed at close) can never re-surface
+        # its nested refs, so an unpinned grant with no live local ref is
+        # released right away — otherwise it would leak at its owner.
         granted = result.get("granted") or []
         if granted:
+            from ray_tpu.core import refs as refs_mod
+
             outer_keys = [r.id.binary() for r in refs]
-            for oid_hex, owner_addr in granted:
+            for entry in granted:
+                if len(entry) == 3:  # streaming: pin to the item's object
+                    oid_hex, owner_addr, item_index = entry
+                    item_key = ObjectID.for_task_return(
+                        spec.task_id, item_index
+                    ).binary()
+                    pins = [item_key] if item_key in self._owned else []
+                else:
+                    oid_hex, owner_addr = entry
+                    pins = outer_keys
                 key = ObjectID.from_hex(oid_hex).binary()
                 if self._is_owner(owner_addr):
                     continue
+                if not pins and refs_mod.local_ref_count(key) == 0:
+                    self.io.spawn(self._notify_owner(
+                        owner_addr, "release_borrow",
+                        oid_hex=oid_hex, addr=self.address,
+                    ))
+                    continue
                 self._reported_borrows.add(key)
                 self._granted_owner[key] = owner_addr
-                self._granting_outers.setdefault(key, set()).update(outer_keys)
-                for ok in outer_keys:
+                self._granting_outers.setdefault(key, set()).update(pins)
+                for ok in pins:
                     self._granted_by_outer.setdefault(ok, set()).add(key)
         self._unpin_task_args(spec.task_id)
-        failed = any(kind == "error" for kind, _ in entries)
+        failed = any(kind == "error" for kind, _ in entries) or any(
+            kind == "streamed" and payload.get("error")
+            for kind, payload in result["results"]
+        )
         self._record_task_event(spec, "FAILED" if failed else "FINISHED")
 
-    def _store_task_error(self, refs, error: BaseException):
+    def _store_task_error(self, refs, error: BaseException, spec=None):
+        if spec is not None and self._fail_stream(spec, error):
+            return  # streaming: the error surfaces on the consumer's next item
         for ref in refs:
             self.memory_store.put_error(ref.id, error)
         if refs:
@@ -1267,6 +1453,10 @@ class CoreWorker:
         spec = self.submitted_specs.get(ref.task_id) if ref.task_id else None
         if spec is None or spec.actor_id is not None:
             return False
+        if getattr(spec, "streaming", False):
+            # streams are not lineage-reconstructable: items may already
+            # have been consumed, so a silent re-run would replay them
+            return False
         key = spec.task_id.binary()
         ev = self._reconstructing.get(key)
         if ev is not None:
@@ -1357,22 +1547,33 @@ class CoreWorker:
                           options: RemoteOptions):
         task_id = TaskID.from_random()
         enc_args, enc_kwargs = ts.encode_args(args, kwargs, self.put)
+        streaming = options.num_returns == "streaming"
         spec = ts.TaskSpec(
             task_id=task_id,
             name=method,
             fn_id=b"",
             args=enc_args,
             kwargs=enc_kwargs,
-            num_returns=max(1, options.num_returns),
+            num_returns=0 if streaming else max(1, options.num_returns),
             resources={},
             owner_addr=self.address,
             actor_id=actor_id,
             actor_method=method,
             max_retries=options.max_task_retries,
+            streaming=streaming,
+            backpressure=options.generator_backpressure_num_objects,
         )
-        refs = spec.return_refs()
-        for r in refs:
-            self._own(r.id)  # actor results owned, but not lineage-rebuildable
+        out = None
+        if streaming:
+            from ray_tpu.streaming import ObjectRefGenerator
+
+            state = self._make_stream(task_id, spec.backpressure, method)
+            refs: List[ObjectRef] = []
+            out = ObjectRefGenerator(state)
+        else:
+            refs = spec.return_refs()
+            for r in refs:
+                self._own(r.id)  # owned, but not lineage-rebuildable
         self._pin_task_args(task_id, enc_args, enc_kwargs)
         # Pipelined per-actor submission (parity:
         # direct_actor_task_submitter.h seq-no pipelining): up to
@@ -1392,7 +1593,7 @@ class CoreWorker:
                     self._actor_queue_consumer(actor_id.binary(), st)
                 )
         self.io.loop.call_soon_threadsafe(st.queue.put_nowait, (spec, refs))
-        return refs
+        return out if out is not None else refs
 
     async def _actor_queue_consumer(self, actor_bin: bytes, st: "_ActorSubmitState"):
         """Single sender per actor: address resolution AND the frame write
@@ -1411,7 +1612,9 @@ class CoreWorker:
                 addr = await self._resolve_actor(actor_bin)
                 if addr is None:
                     self._store_task_error(
-                        refs, exc.ActorDiedError(spec.actor_id, "actor is dead")
+                        refs,
+                        exc.ActorDiedError(spec.actor_id, "actor is dead"),
+                        spec=spec,
                     )
                     st.inflight.pop(seq, None)
                     st.sem.release()
@@ -1443,7 +1646,8 @@ class CoreWorker:
                 continue
             except Exception as e:  # noqa: BLE001 - must not lose the refs
                 self._store_task_error(
-                    refs, exc.RayTpuError(f"actor submission failed: {e!r}")
+                    refs, exc.RayTpuError(f"actor submission failed: {e!r}"),
+                    spec=spec,
                 )
                 st.inflight.pop(seq, None)
                 st.sem.release()
@@ -1462,7 +1666,8 @@ class CoreWorker:
             self._on_pipelined_loss(actor_bin, st, seq, spec, refs)
         except Exception as e:  # noqa: BLE001 - must not lose the refs
             self._store_task_error(
-                refs, exc.RayTpuError(f"actor submission failed: {e!r}")
+                refs, exc.RayTpuError(f"actor submission failed: {e!r}"),
+                spec=spec,
             )
         finally:
             st.inflight.pop(seq, None)
@@ -1472,9 +1677,25 @@ class CoreWorker:
         """Connection loss on a pipelined call: close the window NOW (before
         any further send can resolve the restarted actor's address) and queue
         the call for ordered replay. At-most-once calls (max_retries<=0) may
-        have executed before the connection died, so they fail instead."""
+        have executed before the connection died, so they fail instead.
+        Streaming calls replay only while provably unstarted (no item pushed
+        AND max_task_retries allows it — same rule as the sequential path);
+        otherwise items may already have been consumed, so the producer's
+        death surfaces as ActorDiedError on the consumer's next item (items
+        already pushed stay consumable)."""
         self._actor_addr_cache.pop(actor_bin, None)
-        if spec.max_retries <= 0:
+        if getattr(spec, "streaming", False):
+            state = self._streams.get(spec.task_id.binary())
+            if state is None or state.count > 0 or spec.max_retries <= 0:
+                self._fail_stream(
+                    spec,
+                    exc.ActorDiedError(
+                        spec.actor_id, "actor worker died mid-stream"
+                    ),
+                )
+            else:
+                st.failed[seq] = (spec, refs)
+        elif spec.max_retries <= 0:
             self._store_task_error(
                 refs,
                 exc.ActorDiedError(
@@ -1508,6 +1729,7 @@ class CoreWorker:
                         self._store_task_error(
                             refs,
                             exc.RayTpuError(f"actor submission failed: {e!r}"),
+                            spec=spec,
                         )
         finally:
             st.recovering = False
@@ -1525,7 +1747,8 @@ class CoreWorker:
             addr = await self._resolve_actor(spec.actor_id.binary())
             if addr is None:
                 self._store_task_error(
-                    refs, exc.ActorDiedError(spec.actor_id, "actor is dead")
+                    refs, exc.ActorDiedError(spec.actor_id, "actor is dead"),
+                    spec=spec,
                 )
                 return
             conn = await self._conn_to(addr, kind="worker")
@@ -1534,7 +1757,8 @@ class CoreWorker:
                 resolve_attempt += 1
                 if resolve_attempt > 10:
                     self._store_task_error(
-                        refs, exc.ActorDiedError(spec.actor_id, "unreachable")
+                        refs, exc.ActorDiedError(spec.actor_id, "unreachable"),
+                        spec=spec,
                     )
                     return
                 await asyncio.sleep(_config.actor_restart_backoff_s)
@@ -1549,6 +1773,18 @@ class CoreWorker:
                 return
             except rpc.ConnectionLost:
                 self._actor_addr_cache.pop(spec.actor_id.binary(), None)
+                if getattr(spec, "streaming", False):
+                    state = self._streams.get(spec.task_id.binary())
+                    if state is not None and state.count > 0:
+                        # items may already be consumed: a replay would
+                        # duplicate them — fail on the next item instead
+                        self._fail_stream(
+                            spec,
+                            exc.ActorDiedError(
+                                spec.actor_id, "actor worker died mid-stream"
+                            ),
+                        )
+                        return
                 call_attempt += 1
                 if call_attempt > call_retries:
                     self._store_task_error(
@@ -1556,6 +1792,7 @@ class CoreWorker:
                         exc.ActorDiedError(
                             spec.actor_id, "actor worker died during call"
                         ),
+                        spec=spec,
                     )
                     return
                 await asyncio.sleep(_config.actor_restart_backoff_s)

@@ -18,6 +18,7 @@ from ray_tpu.core.backend import Backend
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.options import RemoteOptions
 from ray_tpu.core.refs import ObjectRef
+from ray_tpu.streaming import ObjectRefGenerator, StreamState
 from ray_tpu.testing import chaos
 
 # which actor's task the current thread is executing (chaos kill-self needs
@@ -36,6 +37,8 @@ class _LocalActor:
         self.num_restarts = 0
         # refs of submitted-but-unfinished tasks; errored out if the actor dies
         self.pending_refs: set = set()
+        # live StreamStates of streaming method calls; failed if the actor dies
+        self.pending_streams: set = set()
         # ordered execution: one dispatch thread pulling a FIFO queue mirrors the
         # sequential actor scheduling queue (max_concurrency>1 uses a pool).
         self._pool = self._new_pool()
@@ -168,9 +171,13 @@ class LocalBackend(Backend):
             err = exc.ActorDiedError(actor_id, reason)
             pending = list(actor.pending_refs)
             actor.pending_refs.clear()
+            streams = list(actor.pending_streams)
+            actor.pending_streams.clear()
             restartable = actor.restarts_left != 0
             if restartable and actor.restarts_left > 0:
                 actor.restarts_left -= 1
+        for st in streams:
+            st.fail(err)
         for r in pending:
             fut = self._future_for(r.id)
             if not fut.done():
@@ -246,8 +253,171 @@ class LocalBackend(Backend):
         for r in refs:
             self._set_value(r, err)
 
+    # ---------------------------------------------------------- streaming
+    def _make_stream(self, options: RemoteOptions, name: str) -> StreamState:
+        from ray_tpu.core.config import _config
+
+        # no explicit window still bounds the producer's lead at the
+        # pipeline cap — an unbounded producer would materialize the whole
+        # stream in the backend store ahead of a slow consumer
+        window = (
+            options.generator_backpressure_num_objects
+            or max(1, _config.streaming_max_inflight_items)
+        )
+        state = StreamState(
+            TaskID.from_random(), owner_addr=None, window=window, name=name
+        )
+        state.set_on_close(self._reclaim_stream)
+        return state
+
+    def _reclaim_stream(self, state: StreamState) -> None:
+        """Drop item futures the consumer never claimed (close/abandon)."""
+        with self._lock:
+            for i in range(state.consumed, state.count):
+                self._objects.pop(
+                    ObjectID.for_task_return(state.task_id, i), None
+                )
+
+    def _stream_oid(self, state: StreamState, index: int) -> ObjectID:
+        return ObjectID.for_task_return(state.task_id, index)
+
+    def _store_stream_item(self, state: StreamState, index: int, value) -> None:
+        fut = self._future_for(self._stream_oid(state, index))
+        try:
+            fut.set_result(value)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+    def _drive_stream(self, state: StreamState, produce, chaos_key: str):
+        """Producer loop: run the generator, publishing each item as its own
+        object the moment it is yielded (push), blocking in wait_credit when
+        a backpressure window is set. Mirrors the cluster worker's
+        _stream_items with in-process stores."""
+        try:
+            result = produce()
+        except chaos.ChaosKilled:
+            state.fail(exc.WorkerCrashedError("chaos kill before streaming"))
+            return
+        except Exception as e:  # noqa: BLE001 - pre-yield user error: item 0
+            self._store_stream_item(state, 0, exc.TaskError.from_exception(e))
+            state.report_item(0, failed=True)
+            state.finish(1)
+            return
+        from ray_tpu.streaming.generator import as_item_iterator
+
+        it = as_item_iterator(result)
+        if it is None:
+            err = exc.TaskError.from_exception(TypeError(
+                f"num_returns='streaming' requires a generator, got "
+                f"{type(result).__name__}"
+            ))
+            self._store_stream_item(state, 0, err)
+            state.report_item(0, failed=True)
+            state.finish(1)
+            return
+        i = 0
+        try:
+            while True:
+                act = chaos.fire("stream.yield", key=chaos_key)
+                if act is not None and act.get("action") == "kill":
+                    chaos.perform_kill_self(
+                        f"chaos kill at stream item {i}"
+                    )  # actor: _fail_actor already failed the state
+                try:
+                    item = next(it)
+                except StopIteration:
+                    state.finish(i)
+                    return
+                except chaos.ChaosKilled:
+                    raise
+                except Exception as e:  # noqa: BLE001 - mid-stream user exc
+                    self._store_stream_item(
+                        state, i, exc.TaskError.from_exception(e)
+                    )
+                    state.report_item(i, failed=True)
+                    state.finish(i + 1)
+                    return
+                self._store_stream_item(state, i, item)
+                state.report_item(i)
+                i += 1
+                # backpressure: block before producing item i while it sits
+                # outside the consumer's window
+                if not state.wait_credit(i):
+                    # consumer closed/abandoned the stream: stop early
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+                    state.finish(i)
+                    return
+        except chaos.ChaosKilled:
+            state.fail(exc.WorkerCrashedError("chaos kill mid-stream"))
+        except BaseException as e:  # noqa: BLE001 - never strand the consumer
+            state.fail(
+                e if isinstance(e, exc.RayTpuError)
+                else exc.RayTpuError(f"stream producer failed: {e!r}")
+            )
+
+    def _submit_streaming_task(self, func, args, kwargs, options):
+        state = self._make_stream(options, getattr(func, "__name__", "task"))
+
+        def produce():
+            rargs, rkwargs = self._resolve_args(args, kwargs)
+            return func(*rargs, **rkwargs)
+
+        threading.Thread(
+            target=self._drive_stream,
+            args=(state, produce, getattr(func, "__name__", "")),
+            daemon=True,
+            name=f"stream-{state.task_id.hex()[:8]}",
+        ).start()
+        return ObjectRefGenerator(state)
+
+    def _submit_streaming_actor_task(self, actor_id, method_name, args,
+                                     kwargs, options):
+        state = self._make_stream(options, method_name)
+        actor = self._actors.get(actor_id)
+        if actor is None or actor.dead:
+            state.fail(exc.ActorDiedError(
+                actor_id, getattr(actor, "death_reason", "unknown")
+            ))
+            return ObjectRefGenerator(state)
+        actor.pending_streams.add(state)
+
+        def run():
+            _current_actor.actor_id = actor_id
+            try:
+                try:
+                    actor.ensure_initialized()
+                except BaseException as e:  # noqa: BLE001 - init failed
+                    state.fail(exc.ActorDiedError(actor_id, f"init failed: {e!r}"))
+                    return
+                key = f"{type(actor.instance).__name__}.{method_name}"
+
+                def produce():
+                    rargs, rkwargs = self._resolve_args(args, kwargs)
+                    act = chaos.fire("actor.call", key=key)
+                    if act is not None and act.get("action") == "kill":
+                        chaos.perform_kill_self(f"chaos kill at {method_name}")
+                    return getattr(actor.instance, method_name)(
+                        *rargs, **rkwargs
+                    )
+
+                self._drive_stream(state, produce, key)
+            finally:
+                _current_actor.actor_id = None
+                actor.pending_streams.discard(state)
+
+        try:
+            actor.submit(run)
+        except RuntimeError:  # pool shut down (actor killed concurrently)
+            state.fail(exc.ActorDiedError(actor_id, actor.death_reason))
+            actor.pending_streams.discard(state)
+        return ObjectRefGenerator(state)
+
     # ------------------------------------------------------------------ tasks
     def submit_task(self, func, args, kwargs, options: RemoteOptions):
+        if options.num_returns == "streaming":
+            return self._submit_streaming_task(func, args, kwargs, options)
         task_id = TaskID.from_random()
         refs = [
             ObjectRef(ObjectID.for_task_return(task_id, i), task_id=task_id)
@@ -307,6 +477,10 @@ class LocalBackend(Backend):
         return actor_id
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
+        if options.num_returns == "streaming":
+            return self._submit_streaming_actor_task(
+                actor_id, method_name, args, kwargs, options
+            )
         task_id = TaskID.from_random()
         refs = [
             ObjectRef(ObjectID.for_task_return(task_id, i), task_id=task_id)
@@ -373,6 +547,9 @@ class LocalBackend(Backend):
         actor = self._actors.pop(actor_id, None)
         if actor:
             actor.death_reason = "killed via ray_tpu.kill"
+            for st in list(actor.pending_streams):
+                st.fail(exc.ActorDiedError(actor_id, actor.death_reason))
+            actor.pending_streams.clear()
 
             def resolve(pending):
                 err = exc.ActorDiedError(actor_id, actor.death_reason)

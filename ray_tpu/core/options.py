@@ -8,7 +8,19 @@ and the per-call ``.options(...)`` override path.
 from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
+
+
+def _validate_num_returns(n) -> None:
+    """int >= 0, or the literal "streaming" (generator tasks/methods push
+    each yielded item as its own object; parity: ray's
+    num_returns="streaming" → ObjectRefGenerator)."""
+    if n == "streaming":
+        return
+    if isinstance(n, bool) or not isinstance(n, int) or n < 0:
+        raise ValueError(
+            f'num_returns must be an int >= 0 or "streaming", got {n!r}'
+        )
 
 
 @dataclass
@@ -17,7 +29,11 @@ class RemoteOptions:
     num_tpus: Optional[float] = None
     memory: Optional[float] = None
     resources: Dict[str, float] = field(default_factory=dict)
-    num_returns: int = 1
+    num_returns: Union[int, str] = 1
+    # streaming only: bound on the producer's lead over the consumer (the
+    # worker blocks in `yield` once this many items are in flight); None =
+    # pipeline freely up to _config.streaming_max_inflight_items
+    generator_backpressure_num_objects: Optional[int] = None
     max_retries: Optional[int] = None          # tasks
     retry_exceptions: bool = False
     max_restarts: int = 0                      # actors
@@ -39,8 +55,11 @@ class RemoteOptions:
         _validate_option_keys(overrides)
         clean = {k: v for k, v in overrides.items() if v is not None or k in ("name",)}
         out = replace(self, **clean)
-        if out.num_returns is not None and out.num_returns < 0:
-            raise ValueError("num_returns must be >= 0")
+        _validate_num_returns(out.num_returns)
+        if out.generator_backpressure_num_objects is not None and (
+            out.generator_backpressure_num_objects < 1
+        ):
+            raise ValueError("generator_backpressure_num_objects must be >= 1")
         return out
 
     def task_resources(self, is_actor: bool = False) -> Dict[str, float]:
@@ -73,8 +92,11 @@ def _validate_option_keys(kwargs):
 def options_from_kwargs(is_actor: bool, **kwargs) -> RemoteOptions:
     _validate_option_keys(kwargs)
     opts = RemoteOptions(**kwargs)
-    if opts.num_returns < 0:
-        raise ValueError("num_returns must be >= 0")
+    _validate_num_returns(opts.num_returns)
+    if opts.generator_backpressure_num_objects is not None and (
+        opts.generator_backpressure_num_objects < 1
+    ):
+        raise ValueError("generator_backpressure_num_objects must be >= 1")
     if not is_actor and (opts.max_restarts or opts.max_task_retries):
         raise ValueError("max_restarts/max_task_retries are actor-only options")
     return opts

@@ -50,6 +50,12 @@ class TaskSpec:
     # packed runtime env (runtime_env.pack wire dict); the executing worker
     # applies it around the task / at actor init
     runtime_env: Optional[dict] = None
+    # streaming generators (num_returns="streaming"): the worker pushes each
+    # yielded item to the owner as its own object
+    # (ObjectID.for_task_return(task_id, index)) instead of returning values
+    # in the reply; `backpressure` bounds the producer's unconsumed lead
+    streaming: bool = False
+    backpressure: Optional[int] = None
 
     def return_refs(self) -> List[ObjectRef]:
         return [

@@ -66,6 +66,10 @@ class WorkerAgent(CoreWorker):
         spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
         logger.debug("push_task %s %s", spec.name, spec.task_id.hex()[:8])
         loop = asyncio.get_running_loop()
+        if spec.streaming:
+            return await loop.run_in_executor(
+                self._exec_pool, self._execute_streaming, spec, conn
+            )
         return await loop.run_in_executor(self._exec_pool, self._execute, spec)
 
     def _env_applier(self):
@@ -257,6 +261,240 @@ class WorkerAgent(CoreWorker):
         blob = cloudpickle.dumps(err)
         return {"results": [("error", blob)] * max(1, spec.num_returns)}
 
+    # ------------------------------------------------- streaming generators
+    # Producer side of ray_tpu/streaming/: drive the user generator and PUSH
+    # each yielded item to the owner as its own sealed object the moment it
+    # is produced — small items inline in the stream_item frame, large ones
+    # through the node shm store (the owner reads them via the existing
+    # location/transfer plane, never a pickle-RPC of the bytes). With a
+    # backpressure window the owner withholds each stream_item reply until
+    # the consumer drains, so this thread blocks in `yield` exactly like the
+    # reference's generator_backpressure_num_objects.
+
+    def _execute_streaming(self, spec: ts.TaskSpec, conn) -> dict:
+        applied = False
+        self._record_task_event(spec, "RUNNING")
+        try:
+            if spec.runtime_env:
+                applied = True
+                self._env_applier().apply(spec.runtime_env)
+            fn = self.io.run(self.load_function(spec.fn_id))
+            args, kwargs = ts.decode_args(
+                spec.args, spec.kwargs,
+                lambda refs: self.get_blocking(refs, None),
+            )
+            return self._stream_items(
+                spec, conn,
+                lambda: fn(*args, **kwargs),
+                chaos_key=spec.name,
+            )
+        except exc.RayTpuError as e:
+            return self._attach_borrows(spec, self._error_result(spec, e, system=True))
+        except BaseException as e:  # noqa: BLE001
+            return self._attach_borrows(spec, self._error_result(spec, e))
+        finally:
+            if applied:
+                self._env_applier().reset()
+
+    def _execute_actor_streaming(self, spec: ts.TaskSpec, conn) -> dict:
+        self._actor_ready.wait(timeout=_config.worker_startup_timeout_s)
+        if self._actor_init_error is not None:
+            return self._error_result(spec, self._actor_init_error)
+        self._record_task_event(spec, "RUNNING")
+        try:
+            from ray_tpu.testing import chaos
+
+            key = (
+                f"{type(self.actor_instance).__name__}.{spec.actor_method}"
+            )
+            act = chaos.fire("actor.call", key=key)
+            if act is not None and act.get("action") == "kill":
+                chaos.perform_kill_self(f"chaos kill at {spec.actor_method}")
+            args, kwargs = ts.decode_args(
+                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+            )
+            method = getattr(self.actor_instance, spec.actor_method)
+            return self._stream_items(
+                spec, conn, lambda: method(*args, **kwargs), chaos_key=key
+            )
+        except BaseException as e:  # noqa: BLE001
+            return self._attach_borrows(spec, self._error_result(spec, e))
+
+    def _stream_items(self, spec: ts.TaskSpec, conn, produce, chaos_key) -> dict:
+        """Drive `produce()` (must return a generator) and push every item.
+
+        Returns the final push_*_task reply: a single ("streamed", {total,
+        error}) entry — the owner turns it into a typed end-of-stream. The
+        reply is written on the same connection AFTER every stream_item
+        frame, so by the time the owner resolves the call future all items
+        are already in its store.
+        """
+        import collections
+
+        from ray_tpu.streaming.generator import as_item_iterator
+        from ray_tpu.testing import chaos
+
+        async def _await(fut):
+            return await fut
+
+        def _payload(index, kind, payload, sync):
+            return dict(
+                task_id_hex=spec.task_id.hex(),
+                index=index, kind=kind, payload=payload, sync=sync,
+            )
+
+        async def _start(index: int, kind: str, payload):
+            return await conn.call_start(
+                "stream_item", **_payload(index, kind, payload, True)
+            )
+
+        async def _notify(index: int, kind: str, payload):
+            try:
+                await conn.notify(
+                    "stream_item", **_payload(index, kind, payload, False)
+                )
+            except rpc.ConnectionLost:
+                pass  # the next sync point surfaces the loss
+
+        def _reply_of(outer, block: bool):
+            """(reply, settled): resolve one queued sync push. `outer` is
+            the spawn future of call_start (resolves once the frame is
+            written); its result is the response future. Non-blocking unless
+            `block` — then (None, False) while still in flight."""
+            if not block and not outer.done():
+                return None, False
+            inner = outer.result()  # frame written (short wait at worst)
+            if inner.done():
+                return inner.result(), True
+            if not block:
+                return None, False
+            return self.io.run(_await(inner), timeout=None), True
+
+        def _send(index: int, kind: str, payload) -> bool:
+            """Push one item WITHOUT waiting for the write (the io loop owns
+            frame ordering). Every `sync_stride`-th item is a request whose
+            reply carries flow control + the consumer-closed signal; the
+            rest are one-way notifies (no response frame per item). Blocks
+            once `max_unacked` sync points are outstanding. Returns False
+            when the owner closed the stream (consumer abandoned it)."""
+            if index % sync_stride == sync_stride - 1:
+                pending.append(self.io.spawn(_start(index, kind, payload)))
+            else:
+                self.io.spawn(_notify(index, kind, payload))
+            while pending:
+                reply, settled = _reply_of(
+                    pending[0], len(pending) >= max_unacked
+                )
+                if not settled:
+                    return True
+                pending.popleft()
+                if reply and reply.get("closed"):
+                    return False
+            return True
+
+        # an explicit backpressure window makes EVERY push a sync point and
+        # allows exactly one outstanding (the owner's withheld reply IS the
+        # credit); otherwise sync every half-cap and run two sync points
+        # ahead, bounding un-acked items at ~streaming_max_inflight_items
+        if spec.backpressure:
+            sync_stride, max_unacked = 1, 1
+        else:
+            sync_stride = max(1, _config.streaming_max_inflight_items // 2)
+            max_unacked = 2
+        pending: "collections.deque" = collections.deque()
+        produced = 0
+        had_error = False
+        granted = []
+        it = None
+        try:
+            try:
+                result = produce()
+            except Exception as e:  # noqa: BLE001 - pre-yield user error
+                _send(0, "error", cloudpickle.dumps(
+                    exc.TaskError.from_exception(e)))
+                return self._stream_reply(spec, 1, True, granted)
+            it = as_item_iterator(result)
+            if it is None:
+                _send(0, "error", cloudpickle.dumps(
+                    exc.TaskError.from_exception(TypeError(
+                        f"num_returns='streaming' requires a generator, got "
+                        f"{type(result).__name__}"
+                    ))))
+                return self._stream_reply(spec, 1, True, granted)
+            while True:
+                act = chaos.fire("stream.yield", key=chaos_key)
+                if act is not None and act.get("action") == "kill":
+                    # real SIGKILL: the raylet reaps this worker and the
+                    # owner's connection loss fails the stream
+                    chaos.perform_kill_self(
+                        f"chaos kill at stream item {produced}"
+                    )
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                except Exception as e:  # noqa: BLE001 - mid-stream user exc
+                    _send(produced, "error", cloudpickle.dumps(
+                        exc.TaskError.from_exception(e)))
+                    produced += 1
+                    had_error = True
+                    break
+                kind, payload = self._encode_stream_item(spec, item, produced,
+                                                         granted)
+                alive = _send(produced, kind, payload)
+                produced += 1
+                if not alive:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+                    break
+            # settle remaining pushes so the reply frame is last on the wire
+            while pending:
+                _reply_of(pending.popleft(), block=True)
+        except rpc.ConnectionLost:
+            # owner is gone: nobody to report to — stop producing
+            if it is not None:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        return self._stream_reply(spec, produced, had_error, granted)
+
+    def _stream_reply(self, spec, total, had_error, granted) -> dict:
+        out = {"results": [("streamed", {"total": total, "error": had_error})]}
+        if granted:
+            out["granted"] = granted
+        return self._attach_borrows(spec, out)
+
+    def _encode_stream_item(self, spec, item, index, granted):
+        """Serialize one yielded item: inline when small, shm-location when
+        large (the data plane the owner already knows how to read). Grants
+        for ObjectRefs nested in the item carry the ITEM index, so the
+        owner pins each borrow to that item's object (not to the stream's
+        nonexistent return refs) — the pin drops when the item frees."""
+        ser = serialization.serialize(item)
+        granted.extend(
+            (oid_hex, owner, index)
+            for oid_hex, owner in self._grant_result_borrows(
+                spec, ser.contained_refs
+            )
+        )
+        data = ser.to_bytes()
+        if len(data) <= _config.max_direct_call_object_size:
+            return "inline", data
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        self.shm.put_bytes(oid, data)
+        if self.raylet:
+            self.io.spawn(self._notify_object_added(oid, len(data)))
+        return "location", {
+            "session": self.session,
+            "raylet_addr": self.raylet_address,
+            "node_id": self.node_id,
+            "nbytes": len(data),
+        }
+
     # -------------------------------------------------------------- actors
     def _init_actor(self, spec_blob):
         try:
@@ -305,6 +543,10 @@ class WorkerAgent(CoreWorker):
         execution, so arrival order == submission order per owner."""
         spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
         loop = asyncio.get_running_loop()
+        if spec.streaming:
+            return await loop.run_in_executor(
+                self._exec_pool, self._execute_actor_streaming, spec, conn
+            )
         return await loop.run_in_executor(
             self._exec_pool, self._execute_actor_task, spec
         )

@@ -152,9 +152,75 @@ def main(duration: float = 2.0):
         duration))
     compiled.teardown()
 
+    # --------------------------------------------- streaming generators
+    _stream_benchmarks(ray_tpu, results, "cluster", duration)
+
     ray_tpu.shutdown()
+
+    # local-mode pass: same polling-vs-push pair on the in-process backend
+    ray_tpu.init(local_mode=True)
+    _stream_benchmarks(ray_tpu, results, "local", duration)
+    ray_tpu.shutdown()
+
     print(json.dumps({"microbenchmark": results}))
     return results
+
+
+def _chunk_source(n):
+    """Generator deployment target for the polling baseline."""
+    def gen():
+        for i in range(n):
+            yield i
+    return gen()
+
+
+def _stream_benchmarks(ray_tpu, results, mode: str, duration: float):
+    """Chunk throughput: the legacy polling protocol (one next_chunk actor
+    RPC round trip per chunk against a ServeReplica sid registry) vs the
+    push-based streaming-generator subsystem (num_returns="streaming",
+    worker-pushed items, zero polling RPCs). The ratio is the recorded
+    speedup the serve streaming rebuild rides on."""
+    from ray_tpu.serve.replica import ServeReplica
+
+    Replica = ray_tpu.remote(max_concurrency=8)(ServeReplica)
+    rep = Replica.remote(_chunk_source, (), {})
+
+    def poll_chunks():
+        n = 100
+        marker = ray_tpu.get(rep.handle_request.remote(n), timeout=60)
+        sid = marker["__serve_stream__"]
+        got = 0
+        while True:
+            c = ray_tpu.get(rep.next_chunk.remote(sid), timeout=60)
+            if c.get("done"):
+                break
+            got += 1
+        assert got == n, got
+        return got
+
+    results.append(timeit(
+        f"stream chunks polling next_chunk ({mode})", poll_chunks, duration))
+
+    @ray_tpu.remote
+    class Streamer:
+        def chunks(self, n):
+            for i in range(n):
+                yield i
+
+    s = Streamer.remote()
+
+    def push_chunks():
+        n = 500
+        got = 0
+        gen = s.chunks.options(num_returns="streaming").remote(n)
+        for ref in gen:
+            ray_tpu.get(ref)
+            got += 1
+        assert got == n, got
+        return got
+
+    results.append(timeit(
+        f"stream chunks push generator ({mode})", push_chunks, duration))
 
 
 if __name__ == "__main__":

@@ -36,6 +36,9 @@ class RemoteFunction:
 
         _auto_init()
         backend = _global_worker().backend
+        if options.num_returns == "streaming":
+            # backend returns an ObjectRefGenerator (push-based per-item refs)
+            return backend.submit_task(self._function, args, kwargs, options)
         refs = backend.submit_task(self._function, args, kwargs, options)
         if options.num_returns == 1:
             return refs[0]

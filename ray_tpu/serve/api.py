@@ -54,6 +54,8 @@ def run(target: Deployment, *, blocking: bool = False,
         from ray_tpu.serve.http_proxy import HTTPProxy
 
         _local["proxy"] = HTTPProxy(controller)
+    # (request_timeout_s reaches the handle through the routing table —
+    # Router.timeout_for — so redeploys with a new timeout are picked up)
     handle = get_handle(target.name)
     # wait for at least one replica
     handle._router.assign_request  # noqa: B018 - attribute check

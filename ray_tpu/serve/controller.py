@@ -101,6 +101,11 @@ class ServeController:
                 "routes": {
                     d.route: name for name, d in self._deployments.items()
                 },
+                "timeouts": {
+                    name: d.request_timeout_s
+                    for name, d in self._deployments.items()
+                    if getattr(d, "request_timeout_s", None) is not None
+                },
             }
 
     def status(self) -> dict:

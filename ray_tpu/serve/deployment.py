@@ -32,6 +32,10 @@ class Deployment:
     init_args: tuple = ()
     init_kwargs: Dict[str, Any] = field(default_factory=dict)
     route_prefix: Optional[str] = None
+    # per-deployment request timeout (dispatch + per-chunk stream waits);
+    # None = _config.serve_request_timeout_s. Propagates through the routing
+    # table so every handle/proxy honors it.
+    request_timeout_s: Optional[float] = None
 
     def options(self, **kwargs) -> "Deployment":
         return replace(self, **kwargs)
@@ -69,6 +73,7 @@ def deployment(
     ray_actor_options: Optional[Dict[str, Any]] = None,
     autoscaling_config: Optional[Any] = None,
     route_prefix: Optional[str] = None,
+    request_timeout_s: Optional[float] = None,
 ):
     """@serve.deployment — wraps a class or function into a Deployment."""
 
@@ -85,6 +90,7 @@ def deployment(
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=ac,
             route_prefix=route_prefix,
+            request_timeout_s=request_timeout_s,
         )
 
     if _func_or_class is not None:

@@ -39,6 +39,7 @@ class Router:
         self._version = -1
         self._replicas: Dict[str, List[Any]] = {}
         self._routes: Dict[str, str] = {}
+        self._timeouts: Dict[str, float] = {}  # per-deployment request timeout
         # dep → replica-id bytes → in-flight count (keyed by stable
         # replica identity, NOT list position: eviction reshuffles indices)
         self._inflight: Dict[str, Dict[bytes, int]] = {}
@@ -67,6 +68,10 @@ class Router:
             self._version = table["version"]
             self._replicas = table["deployments"]
             self._routes = table.get("routes", {})
+            self._timeouts = {
+                k: v for k, v in (table.get("timeouts") or {}).items()
+                if v is not None
+            }
             for name, replicas in self._replicas.items():
                 old = self._inflight.get(name, {})
                 # carry live counts across refreshes; drop dead replicas'
@@ -78,6 +83,13 @@ class Router:
     def deployment_for_route(self, path: str) -> Optional[str]:
         self._refresh()
         return self._routes.get(path)
+
+    def timeout_for(self, deployment: str) -> float:
+        """Effective request timeout: the deployment's request_timeout_s
+        (propagated through the routing table) or the config default."""
+        if deployment not in self._timeouts:
+            self._refresh()
+        return self._timeouts.get(deployment) or _config.serve_request_timeout_s
 
     def assign_request(self, deployment: str, *args, **kwargs):
         """Route one request; returns an ObjectRef. When the backend
@@ -103,6 +115,15 @@ class Router:
     # ------------------------------------------------------------- failover
     def _arm_failover(self, deployment, ref, replica, args, kwargs, fulfill,
                       attempt: int):
+        from ray_tpu.api import _global_worker
+
+        # success-path passthrough: when the backend can hand us the
+        # replica's response as serialized bytes, forward them into the
+        # deferred ref verbatim — cluster mode previously deserialized and
+        # re-serialized every successful response just to relay it
+        backend = _global_worker().backend
+        as_ser = getattr(backend, "as_serialized_future", None)
+
         def done(fut):
             try:
                 value = fut.result()
@@ -118,10 +139,14 @@ class Router:
             except BaseException as e:  # noqa: BLE001 - user exception
                 fulfill(error=e)
                 return
-            fulfill(value=value)
+            if as_ser is not None:
+                fulfill(serialized=value)
+            else:
+                fulfill(value=value)
 
         try:
-            ref.future().add_done_callback(done)
+            fut = as_ser(ref) if as_ser is not None else ref.future()
+            fut.add_done_callback(done)
         except Exception as e:  # noqa: BLE001 - no future support
             fulfill(error=e)
 
@@ -177,16 +202,17 @@ class Router:
             pass
 
     def call_with_failover(self, deployment: str, args=(), kwargs=None,
-                           timeout: float = 60.0):
-        """Blocking route+get with replica failover — the HTTP proxy's and
-        stream()'s dispatch path. Takes the request's args/kwargs as
-        explicit containers (so a deployment's own 'timeout' kwarg can
-        never collide with ours). Returns (result, replica); streaming
-        responses keep pulling chunks from the returned (healthy)
-        replica."""
+                           timeout: Optional[float] = None):
+        """Blocking route+get with replica failover — the legacy-polling
+        dispatch path. Takes the request's args/kwargs as explicit
+        containers (so a deployment's own 'timeout' kwarg can never collide
+        with ours). timeout=None resolves to the deployment/config default.
+        Returns (result, replica); polling consumers keep pulling chunks
+        from the returned (healthy) replica."""
         import ray_tpu
 
         kwargs = kwargs or {}
+        timeout = timeout if timeout is not None else self.timeout_for(deployment)
         attempt = 0
         while True:
             ref, replica = self.assign_request_with_replica(
@@ -218,10 +244,9 @@ class Router:
             time.sleep(0.1)
             self._refresh(force=True)
 
-    def assign_request_with_replica(self, deployment: str, *args, **kwargs):
-        """Pick a replica (power of two choices on local in-flight counts)
-        and dispatch; returns (ObjectRef, replica handle) — streaming keeps
-        pulling chunks from the SAME replica."""
+    def _pick_replica(self, deployment: str):
+        """Power-of-two-choices on local in-flight counts; bumps the chosen
+        replica's count. Returns (replica handle, replica key)."""
         replicas = self.wait_for_replicas(deployment)
         keys = [r._actor_id.binary() for r in replicas]
         with self._lock:
@@ -236,9 +261,63 @@ class Router:
                 )
             rkey = keys[idx]
             counts[rkey] = counts.get(rkey, 0) + 1
-        ref = replicas[idx].handle_request.remote(*args, **kwargs)
+        return replicas[idx], rkey
+
+    def assign_request_with_replica(self, deployment: str, *args, **kwargs):
+        """Pick a replica and dispatch; returns (ObjectRef, replica handle)
+        — legacy-polling streaming keeps pulling chunks from the SAME
+        replica."""
+        replica, rkey = self._pick_replica(deployment)
+        ref = replica.handle_request.remote(*args, **kwargs)
         self._track_completion(deployment, rkey, ref)
-        return ref, replicas[idx]
+        return ref, replica
+
+    def stream_request(self, deployment: str, args=(), kwargs=None,
+                       timeout: Optional[float] = None,
+                       backpressure: Optional[int] = 16):
+        """Push-based streaming dispatch (ray_tpu/streaming/): invoke the
+        replica's generator entry point with ``num_returns="streaming"`` and
+        return ``(header, gen, replica)`` once the header item arrived —
+        chunks then flow worker→owner with ZERO per-chunk polling RPCs.
+
+        The INITIAL dispatch fails over like remote(): a replica that dies
+        before producing its header is evicted, reported, and the request
+        retried on a healthy replica. Once chunks flow the stream is pinned
+        to its replica (generator state lives there), so a mid-stream death
+        raises on the next item. `backpressure` bounds the replica's
+        unconsumed lead (slow clients must not buffer the whole response
+        replica-side)."""
+        import ray_tpu
+
+        kwargs = kwargs or {}
+        timeout = timeout if timeout is not None else self.timeout_for(deployment)
+        attempt = 0
+        while True:
+            replica, rkey = self._pick_replica(deployment)
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming",
+                generator_backpressure_num_objects=backpressure,
+            ).remote(*args, **kwargs)
+            try:
+                header = ray_tpu.get(gen.next_ref(timeout), timeout=timeout)
+                self._dec_inflight(deployment, rkey)
+                return header, gen, replica
+            except (exc.ActorDiedError, exc.ActorUnavailableError):
+                self._dec_inflight(deployment, rkey)
+                self._on_replica_failure(deployment, replica)
+                attempt += 1
+                if attempt > _config.serve_request_retries:
+                    raise
+                self.retry_count += 1
+            except BaseException:
+                self._dec_inflight(deployment, rkey)
+                raise
+
+    def _dec_inflight(self, deployment: str, rkey: bytes) -> None:
+        with self._lock:
+            counts = self._inflight.get(deployment)
+            if counts and counts.get(rkey, 0) > 0:
+                counts[rkey] -= 1
 
     def _track_completion(self, deployment: str, rkey: bytes, ref) -> None:
         def done(_):
@@ -254,11 +333,30 @@ class Router:
 
 
 class DeploymentHandle:
-    """User-facing handle: `handle.remote(...)` → ObjectRef (get for result)."""
+    """User-facing handle: `handle.remote(...)` → ObjectRef (get for result).
 
-    def __init__(self, deployment_name: str, router: Router):
+    ``timeout_s`` (set via ``options()`` or the deployment's
+    ``request_timeout_s``) governs the dispatch and per-chunk waits of
+    ``stream()``; None falls back to the deployment's routing-table timeout
+    or ``_config.serve_request_timeout_s``."""
+
+    def __init__(self, deployment_name: str, router: Router,
+                 timeout_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self._router = router
+        self._timeout_s = timeout_s
+
+    def options(self, *, timeout_s: Optional[float] = None) -> "DeploymentHandle":
+        """Per-handle overrides (currently: request timeout)."""
+        return DeploymentHandle(
+            self.deployment_name, self._router,
+            timeout_s=timeout_s if timeout_s is not None else self._timeout_s,
+        )
+
+    def _timeout(self) -> float:
+        if self._timeout_s is not None:
+            return self._timeout_s
+        return self._router.timeout_for(self.deployment_name)
 
     def remote(self, *args, **kwargs):
         return self._router.assign_request(self.deployment_name, *args, **kwargs)
@@ -284,22 +382,46 @@ class DeploymentHandle:
                                         max_in_flight=max_in_flight)
 
     def stream(self, *args, **kwargs):
-        """Iterate a streaming deployment's chunks as they are produced
-        (parity: the reference's streaming handles / replica.py:231). A
-        non-generator response yields once. The INITIAL dispatch fails over
-        like remote(); once chunks flow, the stream is pinned to its replica
-        (generator state lives there), so a mid-stream death raises."""
+        """Iterate a streaming deployment's chunks as they are produced,
+        over the push-based generator subsystem (ray_tpu/streaming/): the
+        replica pushes every chunk the moment it yields — zero per-chunk
+        polling RPCs. A non-generator response yields once. The INITIAL
+        dispatch fails over like remote(); once chunks flow, the stream is
+        pinned to its replica (generator state lives there), so a mid-stream
+        replica death raises a typed ActorDiedError on the next chunk."""
         import ray_tpu
 
+        timeout = self._timeout()
+        header, gen, _replica = self._router.stream_request(
+            self.deployment_name, args, kwargs, timeout=timeout
+        )
+        streaming = isinstance(header, dict) and header.get("streaming")
+        while True:
+            try:
+                ref = gen.next_ref(timeout)
+            except StopIteration:
+                return
+            yield ray_tpu.get(ref, timeout=timeout)
+            if not streaming:
+                return  # single non-generator result
+
+    def stream_polling(self, *args, **kwargs):
+        """Compatibility fallback: the pre-generator polling protocol (one
+        ``next_chunk`` actor RPC round trip per chunk against the replica's
+        sid registry). Kept for mixed-version replicas and as the
+        microbenchmark baseline; new code should use :meth:`stream`."""
+        import ray_tpu
+
+        timeout = self._timeout()
         first, replica = self._router.call_with_failover(
-            self.deployment_name, args, kwargs, timeout=60
+            self.deployment_name, args, kwargs, timeout=timeout
         )
         if not (isinstance(first, dict) and "__serve_stream__" in first):
             yield first
             return
         sid = first["__serve_stream__"]
         while True:
-            chunk = ray_tpu.get(replica.next_chunk.remote(sid), timeout=60)
+            chunk = ray_tpu.get(replica.next_chunk.remote(sid), timeout=timeout)
             if chunk.get("done"):
                 return
             yield chunk["value"]

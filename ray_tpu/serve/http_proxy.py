@@ -14,6 +14,9 @@ import json
 import threading
 from typing import Optional
 
+# sentinel distinguishing "stream ended" from a legitimate None chunk value
+_STREAM_END = object()
+
 
 class HTTPProxy:
     def __init__(self, controller_handle, host: str = "127.0.0.1", port: int = 0):
@@ -66,9 +69,11 @@ class HTTPProxy:
                 None, self._dispatch, method, path, body
             )
             if status == "stream":
-                # chunked transfer: one JSON line per generator item, flushed
-                # as produced (parity: streaming responses, replica.py:231)
-                replica, sid = payload
+                # chunked transfer: one JSON line per generator item, written
+                # the moment the replica pushes it (ray_tpu/streaming/ —
+                # zero per-chunk polling RPCs; the old next_chunk round trip
+                # survives only as the stream_polling compat fallback)
+                gen, timeout = payload
                 writer.write(
                     b"HTTP/1.1 200 OK\r\n"
                     b"Content-Type: application/jsonl\r\n"
@@ -76,17 +81,20 @@ class HTTPProxy:
                 )
                 await writer.drain()
                 loop = asyncio.get_running_loop()
-                while True:
-                    chunk = await loop.run_in_executor(
-                        None, self._next_chunk, replica, sid
-                    )
-                    if chunk is None:
-                        break
-                    data = (json.dumps(chunk, default=str) + "\n").encode()
-                    writer.write(
-                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
-                    )
-                    await writer.drain()
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(
+                            None, self._next_push_chunk, gen, timeout
+                        )
+                        if chunk is _STREAM_END:
+                            break
+                        data = (json.dumps(chunk, default=str) + "\n").encode()
+                        writer.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                        )
+                        await writer.drain()
+                finally:
+                    gen.close()  # disconnect/error: release the producer
                 writer.write(b"0\r\n\r\n")
                 await writer.drain()
                 return
@@ -120,21 +128,30 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 args = (body.decode("utf-8", "replace"),)
         try:
-            # failover path: a replica dying mid-request costs one retry on
-            # a healthy replica, not a user-visible 500
-            result, replica = self._router.call_with_failover(
-                name, args, timeout=60
+            # push-based dispatch with failover: a replica dying before its
+            # header costs one retry on a healthy replica, not a 500; the
+            # header tells us whether to stream chunked or reply once
+            timeout = self._router.timeout_for(name)
+            header, gen, _replica = self._router.stream_request(
+                name, args, timeout=timeout
             )
-            if isinstance(result, dict) and "__serve_stream__" in result:
-                return "stream", (replica, result["__serve_stream__"])
+            if isinstance(header, dict) and header.get("streaming"):
+                return "stream", (gen, timeout)
+            result = self._next_push_chunk(gen, timeout)
+            gen.close()
+            if result is _STREAM_END:  # defensive: producer yielded nothing
+                return "200 OK", {"result": None}
             return "200 OK", {"result": result}
         except Exception as e:  # noqa: BLE001 - surface as 500
             return "500 Internal Server Error", {"error": str(e)}
 
-    def _next_chunk(self, replica, sid):
+    def _next_push_chunk(self, gen, timeout):
+        """Blocking pull of the next pushed item's value (executor thread);
+        returns _STREAM_END at the typed end-of-stream."""
         import ray_tpu
 
-        chunk = ray_tpu.get(replica.next_chunk.remote(sid), timeout=60)
-        if chunk.get("done"):
-            return None
-        return chunk["value"]
+        try:
+            ref = gen.next_ref(timeout)
+        except StopIteration:
+            return _STREAM_END
+        return ray_tpu.get(ref, timeout=timeout)

@@ -54,6 +54,43 @@ class ServeReplica:
         # report a clean end-of-stream (silent truncation). Bounded FIFO.
         self._reaped: "deque[str]" = deque(maxlen=4096)
         self._reaped_set: set = set()
+        # sids that drained to a clean StopIteration: a duplicate poll is a
+        # benign done, never an "unknown stream" error. Bounded FIFO.
+        self._done: "deque[str]" = deque(maxlen=4096)
+        self._done_set: set = set()
+        # legacy-protocol usage counter (tests assert the push-based serve
+        # path issues ZERO per-chunk polling RPCs)
+        self._legacy_polls = 0
+
+    def handle_request_streaming(self, *args, **kwargs):
+        """Generator entry point for the push-based streaming path: called
+        with ``num_returns="streaming"``, so every yield is pushed to the
+        caller as its own object (ray_tpu/streaming/) — no per-chunk RPCs.
+
+        Protocol: the first item is a header ``{"streaming": bool}``; a
+        generator response then streams its chunks, anything else yields the
+        single result. A mid-chunk user exception surfaces on the exact item
+        that raised (streaming-generator error semantics)."""
+        self._ongoing += 1
+        self._total += 1
+        try:
+            target = self._callable
+            if not callable(target):
+                raise TypeError(f"deployment target {target!r} not callable")
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            if inspect.isgenerator(result) or inspect.isasyncgen(result):
+                from ray_tpu.streaming.generator import as_item_iterator
+
+                yield {"streaming": True}
+                for chunk in as_item_iterator(result):
+                    yield chunk
+            else:
+                yield {"streaming": False}
+                yield result
+        finally:
+            self._ongoing -= 1
 
     def _reap_streams(self) -> None:
         now = time.monotonic()
@@ -102,20 +139,35 @@ class ServeReplica:
             self._ongoing -= 1
 
     def next_chunk(self, sid: str) -> Dict[str, Any]:
+        """Legacy polling path (compatibility fallback; new consumers use
+        handle_request_streaming). An undrained sid that is gone — reaped,
+        LRU-evicted at the MAX_STREAMS cap, or aged out of the bounded reap
+        ledger — must RAISE on the consumer's next poll: only sids recorded
+        as cleanly drained may report a silent done."""
+        self._legacy_polls += 1
         entry = self._streams.get(sid)
         if entry is None:
+            if sid in self._done_set:
+                return {"done": True}
             if sid in self._reaped_set:
                 raise RuntimeError(
                     f"stream {sid} was reaped (idle > "
                     f"{STREAM_IDLE_TIMEOUT_S}s or replica over "
                     f"{MAX_STREAMS} streams); response is incomplete"
                 )
-            return {"done": True}
+            raise RuntimeError(
+                f"stream {sid} is unknown (never registered, or evicted "
+                "undrained and since forgotten); response is incomplete"
+            )
         gen, _ = entry
         try:
             value = next(gen)
         except StopIteration:
             self._streams.pop(sid, None)
+            if len(self._done) == self._done.maxlen:
+                self._done_set.discard(self._done[0])
+            self._done.append(sid)
+            self._done_set.add(sid)
             return {"done": True}
         except Exception:
             self._streams.pop(sid, None)
@@ -127,7 +179,11 @@ class ServeReplica:
         return self._ongoing
 
     def stats(self) -> dict:
-        return {"ongoing": self._ongoing, "total": self._total}
+        return {
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "legacy_polls": self._legacy_polls,
+        }
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

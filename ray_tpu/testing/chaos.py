@@ -26,6 +26,10 @@ point                 where it fires
                       Nth matching call
 ``cgraph.iter``       ``cgraph/executor.py`` ``node_loop`` — a compiled
                       graph participant dies at the Nth loop iteration
+``stream.yield``      streaming-generator producers (``worker_main.
+                      _stream_items`` / ``local_backend._drive_stream``) —
+                      the producer dies right before yielding the Nth item,
+                      so consumers must see a typed error on the next item
 ====================  ======================================================
 
 Usage (context-manager API)::
@@ -102,6 +106,16 @@ class ChaosPlan:
         """Kill a compiled-graph participant at the Nth execution-loop
         iteration whose node methods contain ``match``."""
         return self._rule("cgraph.iter", "kill", match=match, nth=after_iters)
+
+    def kill_stream_producer(self, match: str = "",
+                             after_items: int = 1) -> "ChaosPlan":
+        """Kill the worker driving a streaming generator
+        (``num_returns="streaming"``) right before it yields the Nth item
+        whose producer key (task name / ``Class.method``) contains
+        ``match``. The consumer must observe every item produced before the
+        kill, then a typed ActorDiedError/WorkerCrashedError on the next
+        item — never a hang or a silent end-of-stream."""
+        return self._rule("stream.yield", "kill", match=match, nth=after_items)
 
     def drop_rpc(self, method: str, nth: int = 1) -> "ChaosPlan":
         """Silently drop the Nth outbound request frame for ``method``."""

@@ -1,0 +1,442 @@
+"""Streaming generators (ray_tpu/streaming/): push-based ObjectRefGenerator
+outputs with backpressure, typed failure semantics, and the serve rebuild.
+
+Covers the acceptance surface of the subsystem:
+- num_returns="streaming" for tasks AND actor methods, local + cluster;
+- backpressure: the producer is provably blocked until the consumer drains;
+- a mid-stream user exception surfaces on the exact item that raised;
+- a producer killed mid-stream (chaos-injected) raises a typed error on the
+  consumer's next item instead of hanging;
+- serve handle.stream() and the HTTP chunked path run on the generator
+  subsystem with ZERO per-chunk polling RPCs.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.testing import chaos
+
+
+# --------------------------------------------------------------------------
+# local mode
+# --------------------------------------------------------------------------
+
+def test_local_task_stream_roundtrip(ray_start_local):
+    @ray_tpu.remote
+    def squares(n):
+        for i in range(n):
+            yield i * i
+
+    gen = squares.options(num_returns="streaming").remote(6)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    refs = list(gen)
+    assert all(isinstance(r, ray_tpu.ObjectRef) for r in refs)
+    assert [ray_tpu.get(r) for r in refs] == [i * i for i in range(6)]
+    # the end is typed: a drained generator keeps raising StopIteration
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_local_actor_stream_sync_and_async(ray_start_local):
+    @ray_tpu.remote
+    class Tokens:
+        @ray_tpu.method(num_returns="streaming")
+        def generate(self, prompt, n):
+            for i in range(n):
+                yield f"{prompt}-{i}"
+
+    a = Tokens.remote()
+    out = [ray_tpu.get(r) for r in a.generate.remote("tok", 4)]
+    assert out == ["tok-0", "tok-1", "tok-2", "tok-3"]
+
+    async def drain():
+        vals = []
+        async for ref in a.generate.remote("async", 3):
+            vals.append(ray_tpu.get(ref))
+        return vals
+
+    assert asyncio.run(drain()) == ["async-0", "async-1", "async-2"]
+
+
+def test_local_midstream_exception_on_exact_item(ray_start_local):
+    @ray_tpu.remote
+    def flaky(n):
+        for i in range(n):
+            if i == 3:
+                raise ValueError("boom at 3")
+            yield i
+
+    gen = flaky.options(num_returns="streaming").remote(10)
+    got = []
+    with pytest.raises(ValueError, match="boom at 3"):
+        for ref in gen:
+            got.append(ray_tpu.get(ref))
+    # every item before the raising one was delivered
+    assert got == [0, 1, 2]
+
+
+def test_local_non_generator_is_typed_error(ray_start_local):
+    @ray_tpu.remote
+    def scalar():
+        return 42
+
+    gen = scalar.options(num_returns="streaming").remote()
+    with pytest.raises(TypeError, match="requires a generator"):
+        ray_tpu.get(next(gen))
+
+
+def test_local_backpressure_blocks_producer(ray_start_local):
+    progress = []  # local mode: producer shares memory with the test
+
+    @ray_tpu.remote
+    class Producer:
+        def produce(self, n):
+            for i in range(n):
+                progress.append(i)
+                yield i
+
+    p = Producer.remote()
+    gen = p.produce.options(
+        num_returns="streaming", generator_backpressure_num_objects=2
+    ).remote(20)
+    # consumer does NOT drain: the producer must stall inside the window
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(progress) < 2:
+        time.sleep(0.05)
+    stalled = len(progress)
+    time.sleep(0.3)  # provably blocked: no further progress while undrained
+    assert len(progress) == stalled
+    assert 1 <= stalled <= 3, stalled  # window + at most one in-flight item
+    # draining releases the producer and the stream completes
+    assert [ray_tpu.get(r) for r in gen] == list(range(20))
+    assert len(progress) == 20
+
+
+def test_local_chaos_producer_kill_raises_typed(ray_start_local):
+    @ray_tpu.remote
+    class Src:
+        def chunks(self, n):
+            for i in range(n):
+                yield i
+
+    a = Src.remote()
+    with chaos.plan(seed=3).kill_stream_producer(match="chunks", after_items=3):
+        gen = a.chunks.options(num_returns="streaming").remote(10)
+        got = []
+        with pytest.raises(exc.ActorDiedError):
+            for ref in gen:
+                got.append(ray_tpu.get(ref))
+        assert got == [0, 1]  # items produced before the kill stay readable
+
+
+def test_objectref_generator_not_serializable(ray_start_local):
+    @ray_tpu.remote
+    def g():
+        yield 1
+
+    gen = g.options(num_returns="streaming").remote()
+    import cloudpickle
+
+    with pytest.raises(Exception, match="not serializable"):
+        cloudpickle.dumps(gen)
+    list(gen)
+
+
+# --------------------------------------------------------------------------
+# cluster mode (one shared cluster for the whole module: init is seconds)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def streaming_cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_cluster_task_stream_with_large_items(streaming_cluster):
+    import numpy as np
+
+    @ray_tpu.remote
+    def mixed(n):
+        for i in range(n):
+            if i % 2:
+                # > max_direct_call_object_size: rides the shm store, the
+                # owner reads it through the location plane (not the RPC)
+                yield np.full((200_000,), i, dtype=np.float64)
+            else:
+                yield i
+
+    gen = mixed.options(num_returns="streaming").remote(4)
+    vals = [ray_tpu.get(r) for r in gen]
+    assert vals[0] == 0 and vals[2] == 2
+    assert vals[1].shape == (200_000,) and vals[1][0] == 1.0
+    assert vals[3][-1] == 3.0
+
+
+def test_cluster_actor_stream_and_midstream_error(streaming_cluster):
+    @ray_tpu.remote
+    class Tokens:
+        @ray_tpu.method(num_returns="streaming")
+        def generate(self, n, fail_at=None):
+            for i in range(n):
+                if fail_at is not None and i == fail_at:
+                    raise RuntimeError(f"boom at {i}")
+                yield i * 10
+
+    a = Tokens.remote()
+    assert [ray_tpu.get(r) for r in a.generate.remote(5)] == [
+        0, 10, 20, 30, 40
+    ]
+    gen = a.generate.remote(5, fail_at=2)
+    got = []
+    with pytest.raises(RuntimeError, match="boom at 2"):
+        for ref in gen:
+            got.append(ray_tpu.get(ref))
+    assert got == [0, 10]
+
+
+def test_cluster_backpressure_blocks_producer(streaming_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def value(self):
+            return self.n
+
+    @ray_tpu.remote
+    class Producer:
+        def produce(self, n, counter):
+            for i in range(n):
+                ray_tpu.get(counter.inc.remote())
+                yield i
+
+    c = Counter.remote()
+    p = Producer.remote()
+    gen = p.produce.options(
+        num_returns="streaming", generator_backpressure_num_objects=2
+    ).remote(15, c)
+    # wait until the producer's side-channel counter stops moving
+    last, stable_since = -1, time.monotonic()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        cur = ray_tpu.get(c.value.remote(), timeout=30)
+        if cur != last:
+            last, stable_since = cur, time.monotonic()
+        elif cur > 0 and time.monotonic() - stable_since > 1.0:
+            break
+        time.sleep(0.1)
+    assert 1 <= last <= 3, last  # provably blocked inside the window
+    assert [ray_tpu.get(r) for r in gen] == list(range(15))
+    assert ray_tpu.get(c.value.remote(), timeout=30) == 15
+
+
+def test_cluster_serialized_passthrough_deferred(streaming_cluster):
+    """Serve-failover satellite: the deferred ref accepts pre-serialized
+    bytes and as_serialized_future hands back bytes — the success relay
+    never decodes + re-encodes the replica response."""
+    from ray_tpu.api import _global_worker
+
+    backend = _global_worker().backend
+    src = ray_tpu.put({"payload": list(range(10))})
+    data = backend.as_serialized_future(src).result(timeout=30)
+    assert isinstance(data, (bytes, memoryview))
+    ref, fulfill = backend.create_deferred()
+    fulfill(serialized=data)
+    assert ray_tpu.get(ref, timeout=30) == {"payload": list(range(10))}
+    # error path still types correctly
+    ref2, fulfill2 = backend.create_deferred()
+    fulfill2(error=exc.ActorDiedError(None, "relay"))
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(ref2, timeout=30)
+
+
+# --------------------------------------------------------------------------
+# serve on the generator subsystem
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_streaming(streaming_cluster):
+    from ray_tpu import serve
+
+    yield streaming_cluster, serve
+    serve.shutdown()
+
+
+def test_serve_stream_push_no_polling(serve_streaming):
+    ray, serve = serve_streaming
+
+    @serve.deployment(name="gen", route_prefix="/gen", request_timeout_s=30)
+    def gen(payload):
+        def chunks():
+            for i in range(int(payload["n"])):
+                yield {"i": i, "sq": i * i}
+        return chunks()
+
+    handle = serve.run(gen, http=True)
+    # deployment-level timeout propagated to the handle and routing table
+    assert handle._timeout() == 30
+    assert handle._router.timeout_for("gen") == 30
+    assert handle.options(timeout_s=5)._timeout() == 5
+
+    out = list(handle.stream({"n": 5}))
+    assert out == [{"i": i, "sq": i * i} for i in range(5)]
+
+    # HTTP chunked transfer through the proxy, still push-based
+    import http.client
+
+    addr = serve.http_address().replace("http://", "")
+    host, port = addr.rsplit(":", 1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        conn.request("POST", "/gen", body=json.dumps({"n": 4}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status == 200:
+            break
+        resp.read()
+        conn.close()
+        time.sleep(0.25)
+    assert resp.status == 200
+    assert resp.headers.get("Transfer-Encoding") == "chunked"
+    lines = [json.loads(l) for l in resp.read().decode().strip().split("\n")]
+    assert lines == [{"i": i, "sq": i * i} for i in range(4)]
+    conn.close()
+
+    # ZERO per-chunk polling RPCs reached any replica
+    from ray_tpu.serve import api as serve_api
+
+    table = ray.get(
+        serve_api._local["controller"].routing_table.remote(-1), timeout=30
+    )
+    for replica in table["deployments"]["gen"]:
+        stats = ray.get(replica.stats.remote(), timeout=30)
+        assert stats["legacy_polls"] == 0, stats
+    serve.delete("gen")
+
+
+def test_serve_stream_polling_fallback_still_works(serve_streaming):
+    ray, serve = serve_streaming
+
+    @serve.deployment(name="legacy")
+    def legacy(payload):
+        def chunks():
+            for i in range(int(payload["n"])):
+                yield i
+        return chunks()
+
+    handle = serve.run(legacy)
+    assert list(handle.stream_polling({"n": 4})) == [0, 1, 2, 3]
+    serve.delete("legacy")
+
+
+def test_serve_stream_midstream_error_surfaces(serve_streaming):
+    ray, serve = serve_streaming
+
+    @serve.deployment(name="flaky_stream")
+    def flaky(payload):
+        def chunks():
+            yield 1
+            yield 2
+            raise RuntimeError("stream blew up")
+        return chunks()
+
+    handle = serve.run(flaky)
+    got = []
+    with pytest.raises(RuntimeError, match="stream blew up"):
+        for chunk in handle.stream({}):
+            got.append(chunk)
+    assert got == [1, 2]
+    serve.delete("flaky_stream")
+
+
+# --------------------------------------------------------------------------
+# legacy next_chunk reaper edge (satellite): eviction must raise, not
+# silently truncate, even for sids the bounded reap ledger forgot
+# --------------------------------------------------------------------------
+
+def test_next_chunk_lru_eviction_always_raises(monkeypatch):
+    from ray_tpu.serve import replica as replica_mod
+    from ray_tpu.serve.replica import ServeReplica
+
+    def streamer(n):
+        def gen():
+            for i in range(n):
+                yield i
+        return gen()
+
+    r = ServeReplica(streamer, (), {})
+    monkeypatch.setattr(replica_mod, "MAX_STREAMS", 2)
+
+    sid1 = r.handle_request(5)["__serve_stream__"]
+    assert r.next_chunk(sid1) == {"done": False, "value": 0}
+    sid2 = r.handle_request(5)["__serve_stream__"]
+    sid3 = r.handle_request(5)["__serve_stream__"]  # evicts sid1 at the cap
+    assert sid1 not in r._streams
+    # the evicted, undrained stream raises on the consumer's next poll
+    with pytest.raises(RuntimeError, match="reaped"):
+        r.next_chunk(sid1)
+    # even a sid the bounded reap ledger has forgotten must raise — silent
+    # truncation is never an option for unknown sids
+    r._reaped_set.discard(sid1)
+    with pytest.raises(RuntimeError, match="unknown"):
+        r.next_chunk(sid1)
+    # a cleanly drained sid reports benign done on a duplicate poll
+    while not r.next_chunk(sid3).get("done"):
+        pass
+    assert r.next_chunk(sid3) == {"done": True}
+    # never-registered sids raise instead of lying about completion
+    with pytest.raises(RuntimeError, match="unknown"):
+        r.next_chunk("no-such-sid")
+    assert r.next_chunk(sid2)["value"] == 0
+
+
+# --------------------------------------------------------------------------
+# chaos: producer SIGKILL mid-stream in cluster mode. LAST in the module —
+# the plan must be active before daemons/workers spawn, so this test owns
+# its cluster (and tears down the module-shared one).
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout=180)
+def test_cluster_chaos_producer_kill_raises_typed():
+    """A chaos-SIGKILLed producer worker fails the stream with a typed
+    error on the consumer's next item — never a hang or silent end. The
+    cluster starts INSIDE the plan so every daemon/worker inherits it."""
+    ray_tpu.shutdown()
+
+    @ray_tpu.remote
+    class Src:
+        def chunks(self, n):
+            for i in range(n):
+                yield i
+
+    with chaos.plan(seed=7).kill_stream_producer(match="chunks",
+                                                 after_items=3) as plan:
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            a = Src.remote()
+            gen = a.chunks.options(
+                num_returns="streaming",
+                generator_backpressure_num_objects=1,  # kill mid-iteration
+            ).remote(100)
+            got = []
+            with pytest.raises(exc.ActorDiedError):
+                while True:
+                    got.append(ray_tpu.get(gen.next_ref(60), timeout=60))
+            assert got == [0, 1]  # produced-before-kill items stay readable
+            # the SIGKILL really fired in the producer worker process
+            fired = [e for e in plan.events() if e["point"] == "stream.yield"]
+            assert fired and fired[0]["action"] == "kill"
+        finally:
+            ray_tpu.shutdown()
