@@ -28,6 +28,8 @@ from ray_tpu.core.config import _config
 from ray_tpu.core.refs import ObjectRef
 from ray_tpu.streaming import ObjectRefGenerator
 from ray_tpu import exceptions
+from ray_tpu import tracing
+from ray_tpu.tracing import profile_span
 
 __all__ = [
     "__version__",
@@ -49,4 +51,6 @@ __all__ = [
     "ObjectRef",
     "ObjectRefGenerator",
     "exceptions",
+    "tracing",
+    "profile_span",
 ]

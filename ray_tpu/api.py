@@ -217,36 +217,19 @@ def nodes() -> List[dict]:
 
 def timeline(filename: Optional[str] = None) -> List[dict]:
     """Chrome-trace export of task execution (parity: ray.timeline,
-    python/ray/_private/state.py). Pairs RUNNING→FINISHED/FAILED task events
-    from the GCS into complete ("X") events; open the file in
+    python/ray/_private/state.py), backed by the tracing subsystem
+    (ray_tpu/tracing/): one trace-process row per node, one thread row per
+    worker; RUNNING→EXECUTED/FINISHED/FAILED pairs render as complete ("X")
+    slices, other lifecycle transitions as instants, profile_span() spans
+    as slices on the worker that recorded them. Open the file in
     chrome://tracing or Perfetto. Returns the event list; also writes JSON
     when `filename` is given."""
     import json
 
-    from ray_tpu.util.state import list_tasks
+    from ray_tpu.tracing import build_chrome_trace
+    from ray_tpu.util.state import timeline_events
 
-    # RUNNING (executing worker) and FINISHED (driver) flush on independent
-    # 1s loops, so arrival order can invert — pair in timestamp order
-    events = sorted(list_tasks(limit=10_000), key=lambda e: e.get("time", 0))
-    starts: Dict[str, dict] = {}
-    out: List[dict] = []
-    for e in events:
-        if e.get("state") == "RUNNING":
-            starts[e["task_id"]] = e
-        elif e.get("state") in ("FINISHED", "FAILED"):
-            s = starts.pop(e["task_id"], None)
-            if s is None:
-                continue
-            out.append({
-                "name": e.get("name", "task"),
-                "cat": "actor_task" if e.get("actor_id") else "task",
-                "ph": "X",
-                "ts": s["time"] * 1e6,
-                "dur": max(0.0, (e["time"] - s["time"]) * 1e6),
-                "pid": s.get("worker", "?"),
-                "tid": s.get("worker", "?"),
-                "args": {"task_id": e["task_id"], "state": e["state"]},
-            })
+    out = build_chrome_trace(timeline_events())
     if filename:
         with open(filename, "w") as f:
             json.dump(out, f)

@@ -76,7 +76,14 @@ def node_loop(instance, nodes: List[ExecNode], in_channels: List[Any],
 
     Returns the number of completed iterations (resolved by the loop's
     ObjectRef after teardown, so the driver can surface loop crashes)."""
+    from ray_tpu import tracing
     from ray_tpu.testing import chaos
+
+    # tracing: the loop is the compiled hot path, so it records a sampled
+    # marker (every 64th iteration, plus iteration 0) rather than per-seq
+    # events — enough to place the loop on the timeline without taxing it
+    _trace_buf = tracing.get_buffer()
+    _TRACE_STRIDE = 64
 
     consumed = {
         payload
@@ -110,6 +117,11 @@ def node_loop(instance, nodes: List[ExecNode], in_channels: List[Any],
             return iterations
         if stopping:
             return iterations
+        if iterations % _TRACE_STRIDE == 0 and _trace_buf.enabled():
+            _trace_buf.record_profile(
+                "cgraph.loop", component="cgraph",
+                args={"loop": loop_key, "iteration": iterations},
+            )
         iterations += 1
 
 

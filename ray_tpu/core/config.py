@@ -97,7 +97,17 @@ class Config:
 
     # --- logging / events ---------------------------------------------------
     log_to_driver: bool = True
+    # tracing (ray_tpu/tracing/): master switch for task-event recording
+    task_events_enabled: bool = True
+    # deterministic trace/task sampling in [0, 1]: whole traces keep or drop
+    # together (hash of the trace/task id), never half-recorded requests
+    task_events_sample_rate: float = 1.0
+    # per-process bounded buffer; overflow drops (and counts) instead of
+    # blocking the hot path (task_event_buffer.h parity)
     task_events_buffer_size: int = 10_000
+    task_events_flush_interval_ms: int = 1_000
+    # GCS-side retention: max tasks kept in the aggregator (oldest evicted)
+    task_events_max_tasks: int = 10_000
     metrics_report_interval_ms: int = 2_000
 
     def __post_init__(self):

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
+from ray_tpu import tracing
 from ray_tpu.core import rpc, serialization, task_spec as ts
 from ray_tpu.core.config import _config
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
@@ -169,10 +170,10 @@ class CoreWorker:
         self._granted_by_outer: Dict[bytes, set] = {}  # outer → inner keys
         self._granted_owner: Dict[bytes, str] = {}     # inner → owner addr
         self._early_borrow_releases: Dict[bytes, set] = {}  # release-before-add
-        # observability: buffered task events, flushed to GCS periodically
-        # (task_event_buffer.h:193)
-        self._task_events: List[dict] = []
-        self._task_events_lock = threading.Lock()
+        # observability: bounded per-process task-event buffer, flushed to
+        # the GCS aggregator periodically (ray_tpu/tracing/, parity:
+        # task_event_buffer.h:193)
+        self.events = tracing.get_buffer()
         self._fn_cache: Dict[bytes, Any] = {}
         self._registered_fns: set = set()
         self._registered_blobs: Dict[bytes, bytes] = {}
@@ -207,6 +208,10 @@ class CoreWorker:
         self.server = rpc.RpcServer(self)
         await self.server.start()
         self.address = self.server.address
+        # default attribution for spans recorded in this process
+        # (profile_span, serve/cgraph spans) — puts them on this worker's
+        # timeline row
+        self.events.set_identity(self.node_id, self.address)
         # generous retry window: daemons may still be importing (cold start on
         # a loaded host takes seconds)
         self.gcs = await rpc.connect(
@@ -396,9 +401,11 @@ class CoreWorker:
         # replies (every sync carries this credit check) are withheld once
         # the producer runs streaming_max_inflight_items ahead, so a slow
         # consumer never materializes the whole stream in our memory store
+        explicit = bool(window)
         window = window or max(1, _config.streaming_max_inflight_items)
         state = StreamState(
-            task_id, owner_addr=self.address, window=window, name=name
+            task_id, owner_addr=self.address, window=window, name=name,
+            explicit_window=explicit,
         )
         state.set_on_close(self._close_stream)
         self._streams[task_id.binary()] = state
@@ -464,7 +471,14 @@ class CoreWorker:
         return {"consumed": state.consumed}
 
     # ------------------------------------------------------------- put/get
+    # tracing: put/get record "core.put"/"core.get" spans, but only for
+    # operations that took >= _PROFILE_MIN_DUR_S — sub-millisecond hot-path
+    # calls (inline-ready gets, tiny puts) stay span-free so tight get/put
+    # loops don't flood the bounded event buffer.
+    _PROFILE_MIN_DUR_S = 0.001
+
     def put(self, value: Any) -> ObjectRef:
+        t0 = time.perf_counter()
         oid = ObjectID.for_put(self.worker_id)
         data = serialization.serialize(value).to_bytes()
         ref = ObjectRef(oid, owner_addr=self.address)
@@ -473,6 +487,13 @@ class CoreWorker:
             self.memory_store.put_value(oid, data)
         else:
             self._put_shm(oid, data)
+        dur = time.perf_counter() - t0
+        if dur >= self._PROFILE_MIN_DUR_S and self.events.enabled():
+            self.events.record_profile(
+                "core.put", dur=dur, component="core",
+                node_id=self.node_id, worker=self.address,
+                args={"nbytes": len(data)},
+            )
         return ref
 
     def _put_shm(self, oid: ObjectID, data: bytes):
@@ -493,6 +514,22 @@ class CoreWorker:
             pass
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        if not self.events.enabled():
+            return self._get_untraced(refs, timeout)
+        t0 = time.perf_counter()
+        try:
+            return self._get_untraced(refs, timeout)
+        finally:
+            dur = time.perf_counter() - t0
+            if dur >= self._PROFILE_MIN_DUR_S:
+                self.events.record_profile(
+                    "core.get", dur=dur, component="core",
+                    node_id=self.node_id, worker=self.address,
+                    args={"num_refs": len(refs)},
+                )
+
+    def _get_untraced(self, refs: Sequence[ObjectRef],
+                      timeout: Optional[float]) -> List[Any]:
         # Fast path: every ref already resolved INLINE in our memory store →
         # decode on the calling thread, skipping the io-loop round trip
         # (~0.5ms each under load). This is the hot shape of streaming
@@ -834,6 +871,8 @@ class CoreWorker:
             runtime_env=self._pack_runtime_env(options),
             streaming=streaming,
             backpressure=options.generator_backpressure_num_objects,
+            trace_id=tracing.current_trace_id(),
+            parent_task_id=tracing.current_task_id(),
         )
         self.submitted_specs[task_id] = spec
         self._pin_task_args(task_id, enc_args, enc_kwargs)
@@ -869,6 +908,7 @@ class CoreWorker:
                             "streaming task %s worker crashed before first "
                             "item; retry %d", spec.name, attempts,
                         )
+                        spec.attempt = attempts
                         continue
                 self._fail_stream(spec, e)
                 return
@@ -897,15 +937,17 @@ class CoreWorker:
                     logger.warning(
                         "task %s worker crashed; retry %d", spec.name, attempts
                     )
+                    spec.attempt = attempts
                     continue
-                self._store_task_error(refs, e)
+                self._store_task_error(refs, e, spec=spec)
                 return
             except exc.RayTpuError as e:
-                self._store_task_error(refs, e)
+                self._store_task_error(refs, e, spec=spec)
                 return
             except Exception as e:  # noqa: BLE001 - protocol failure
                 self._store_task_error(
-                    refs, exc.RayTpuError(f"task submission failed: {e!r}")
+                    refs, exc.RayTpuError(f"task submission failed: {e!r}"),
+                    spec=spec,
                 )
                 return
 
@@ -967,6 +1009,7 @@ class CoreWorker:
         key = self._sched_key(spec)
         pool = self._lease_pool(key)
         entry = await self._acquire_lease(pool, spec)
+        self._record_task_event(spec, "DISPATCHED", worker=entry.worker_addr)
         try:
             blob = cloudpickle.dumps(spec)
             result = await entry.conn.call(
@@ -1104,6 +1147,12 @@ class CoreWorker:
                     pg_id=spec.placement_group_id,
                     bundle_index=spec.placement_group_bundle_index,
                     req_id=req_id,
+                    # tracing: the raylet records the LEASED event for the
+                    # task that triggered this request (cached-lease reuse
+                    # means later same-key tasks skip the raylet entirely)
+                    task_id=spec.task_id.hex(),
+                    task_name=spec.name,
+                    trace_id=getattr(spec, "trace_id", None),
                     timeout=None,
                 )
             except rpc.ConnectionLost as e:
@@ -1251,29 +1300,30 @@ class CoreWorker:
             self.memory_store.put_error(ref.id, error)
         if refs:
             self._unpin_task_args(refs[0].task_id)
+        if spec is not None:
+            self._record_task_event(spec, "FAILED")
 
     # ---------------------------------------------------------- task events
-    def _record_task_event(self, spec, state: str) -> None:
-        with self._task_events_lock:
-            self._task_events.append({
-                "task_id": spec.task_id.hex(),
-                "name": spec.name,
-                "state": state,
-                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-                "time": time.time(),
-                "worker": self.address,
-            })
+    def _record_task_event(self, spec, state: str, worker: Optional[str] = None,
+                           args: Optional[dict] = None) -> None:
+        self.events.record(
+            task_id=spec.task_id.hex(),
+            name=spec.name,
+            state=state,
+            attempt=getattr(spec, "attempt", 0),
+            parent_id=getattr(spec, "parent_task_id", None),
+            actor_id=spec.actor_id.hex() if spec.actor_id else None,
+            node_id=self.node_id,
+            worker=worker or self.address,
+            trace_id=getattr(spec, "trace_id", None),
+            args=args,
+        )
 
     async def _flush_task_events_loop(self):
-        while True:
-            await asyncio.sleep(1.0)
-            with self._task_events_lock:
-                events, self._task_events = self._task_events, []
-            if events and self.gcs and not self.gcs.closed:
-                try:
-                    await self.gcs.call("report_task_events", events=events)
-                except (rpc.RpcError, rpc.ConnectionLost):
-                    pass
+        await tracing.events.flush_task_events_loop(
+            self.events, lambda: self.gcs,
+            source=f"{self.mode}-{self.worker_id.hex()[:12]}",
+        )
 
     # ----------------------------------------------- distributed refcounting
     # Owner-based (reference_count.h:61): the submitting/putting process owns
@@ -1562,7 +1612,10 @@ class CoreWorker:
             max_retries=options.max_task_retries,
             streaming=streaming,
             backpressure=options.generator_backpressure_num_objects,
+            trace_id=tracing.current_trace_id(),
+            parent_task_id=tracing.current_task_id(),
         )
+        self._record_task_event(spec, "SUBMITTED")
         out = None
         if streaming:
             from ray_tpu.streaming import ObjectRefGenerator
@@ -1701,6 +1754,7 @@ class CoreWorker:
                 exc.ActorDiedError(
                     spec.actor_id, "actor worker died during call"
                 ),
+                spec=spec,
             )
         else:
             st.failed[seq] = (spec, refs)

@@ -128,11 +128,11 @@ class GcsServer:
         self._conn_owned_actors: Dict[rpc.Connection, Set[bytes]] = {}
         self._conn_owned_pgs: Dict[rpc.Connection, Set[bytes]] = {}
         self._bg: List[asyncio.Task] = []
-        # observability: bounded task-event log (GcsTaskManager analog,
-        # gcs_task_manager.h:61) + monotonically-counted cluster metrics
-        from collections import deque
+        # observability: bounded per-task event aggregation (GcsTaskManager
+        # analog, gcs_task_manager.h:61) + monotonically-counted metrics
+        from ray_tpu.tracing import TaskEventAggregator
 
-        self.task_events: "deque" = deque(maxlen=10_000)
+        self.task_events = TaskEventAggregator()
         self.metrics: Dict[str, int] = {}
         # metrics plane: {source: (ts, [series snapshots])} flushed by every
         # process's registry (util/metrics.py); dashboard /metrics renders
@@ -661,17 +661,35 @@ class GcsServer:
         return self.actors[actor_id].public()
 
     # ------------------------------------------------------- observability
-    def handle_report_task_events(self, conn, events: List[dict]):
-        """Workers/drivers flush buffered task state transitions here
-        (task_event_buffer.h:193 → GcsTaskManager)."""
-        self.task_events.extend(events)
+    def handle_report_task_events(self, conn, events: List[dict],
+                                  dropped: int = 0, source: str = None):
+        """Workers/drivers/raylets flush buffered task state transitions
+        here (task_event_buffer.h:193 → GcsTaskManager). ``dropped`` is the
+        source's CUMULATIVE drop counter (bounded-buffer overflow + flush
+        failures), surfaced through metrics and get_task."""
+        self.task_events.ingest(events, dropped=dropped, source=source)
         for e in events:
-            key = f"tasks_{e.get('state', 'UNKNOWN').lower()}"
+            state = e.get("state", "UNKNOWN")
+            if state == "PROFILE":
+                continue
+            key = f"tasks_{state.lower()}"
             self.metrics[key] = self.metrics.get(key, 0) + 1
         return True
 
     def handle_list_tasks(self, conn, limit=1000):
-        return list(self.task_events)[-limit:]
+        """One row per task: latest state, ids hex-normalized."""
+        return self.task_events.list_tasks(limit)
+
+    def handle_get_task(self, conn, task_id: str):
+        """Full event timeline of one task (state-API get_task)."""
+        return self.task_events.get_task(task_id)
+
+    def handle_summarize_tasks(self, conn):
+        return self.task_events.summarize()
+
+    def handle_timeline_events(self, conn, limit=50_000):
+        """Flat event list backing ray_tpu.timeline()'s Chrome-trace export."""
+        return self.task_events.timeline_events(limit)
 
     def handle_list_placement_groups(self, conn):
         return [
@@ -687,6 +705,7 @@ class GcsServer:
 
     def handle_get_metrics(self, conn):
         m = dict(self.metrics)
+        m.update(self.task_events.stats())  # tracing drop/retention counters
         m["num_nodes"] = len(self.nodes)
         m["num_alive_nodes"] = sum(1 for n in self.nodes.values() if n.alive)
         m["num_actors"] = len(self.actors)

@@ -14,6 +14,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu import tracing
 from ray_tpu.core.backend import Backend
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.options import RemoteOptions
@@ -111,6 +112,14 @@ class LocalBackend(Backend):
         self._lock = threading.Lock()
         self._cancelled: set = set()
         self._actor_listeners: List[Any] = []
+        # tracing: local mode has no GCS — the process buffer drains into an
+        # in-process aggregator on every state query (no flush thread)
+        self._events = tracing.get_buffer()
+        self._events.set_identity("local", f"local-{self.worker_id.hex()[:8]}")
+        self._aggregator = tracing.TaskEventAggregator()
+        # task_id hex → task name, so a death path (which only has refs)
+        # can still record a named FAILED event
+        self._task_names: Dict[str, str] = {}
         # chaos "kill" actions executed on an actor thread route here
         chaos.set_local_actor_killer(self._chaos_kill_current)
 
@@ -178,6 +187,7 @@ class LocalBackend(Backend):
                 actor.restarts_left -= 1
         for st in streams:
             st.fail(err)
+            self._record(st.task_id, st.name, "FAILED", actor_id=actor_id)
         for r in pending:
             fut = self._future_for(r.id)
             if not fut.done():
@@ -185,6 +195,9 @@ class LocalBackend(Backend):
                     fut.set_result(err)
                 except concurrent.futures.InvalidStateError:
                     pass
+            if r.task_id is not None:
+                # the timeline must end FAILED, never a phantom RUNNING
+                self._record(r.task_id, "", "FAILED", actor_id=actor_id)
         actor._pool.shutdown(wait=False, cancel_futures=True)
         actor.death_reason = reason
         if restartable:
@@ -201,6 +214,42 @@ class LocalBackend(Backend):
                         del self._named_actors[key]
             self._emit_actor_event(actor_id, "DEAD", reason)
         return True
+
+    # --------------------------------------------------------------- tracing
+    def _record(self, task_id: TaskID, name: str, state: str,
+                actor_id: Optional[ActorID] = None,
+                trace_id: Optional[str] = None,
+                parent_id: Optional[str] = None,
+                args: Optional[dict] = None) -> None:
+        tid = task_id.hex()
+        # _record runs on every task/actor thread — the name map (and its
+        # eviction) must be serialized or concurrent evictions corrupt it
+        with self._lock:
+            if name:
+                self._task_names.setdefault(tid, name)
+                # bounded like the aggregator's retention: evict oldest
+                # names so a long-lived local driver doesn't leak one entry
+                # per task
+                from ray_tpu.core.config import _config
+
+                cap = max(1000, _config.task_events_max_tasks)
+                while len(self._task_names) > cap:
+                    self._task_names.pop(next(iter(self._task_names)))
+            else:
+                name = self._task_names.get(tid, "")
+        self._events.record(
+            task_id=tid, name=name, state=state,
+            actor_id=actor_id.hex() if actor_id else None,
+            node_id="local", worker=f"local-{self.worker_id.hex()[:8]}",
+            trace_id=trace_id if trace_id is not None
+            else tracing.current_trace_id(),
+            parent_id=parent_id, args=args,
+        )
+
+    def _sync_events(self):
+        events, dropped = self._events.drain()
+        self._aggregator.ingest(events, dropped=dropped, source="local")
+        return self._aggregator
 
     # ------------------------------------------------------------------ utils
     def _future_for(self, oid: ObjectID) -> concurrent.futures.Future:
@@ -260,12 +309,14 @@ class LocalBackend(Backend):
         # no explicit window still bounds the producer's lead at the
         # pipeline cap — an unbounded producer would materialize the whole
         # stream in the backend store ahead of a slow consumer
+        explicit = bool(options.generator_backpressure_num_objects)
         window = (
             options.generator_backpressure_num_objects
             or max(1, _config.streaming_max_inflight_items)
         )
         state = StreamState(
-            TaskID.from_random(), owner_addr=None, window=window, name=name
+            TaskID.from_random(), owner_addr=None, window=window, name=name,
+            explicit_window=explicit,
         )
         state.set_on_close(self._reclaim_stream)
         return state
@@ -293,6 +344,16 @@ class LocalBackend(Backend):
         object the moment it is yielded (push), blocking in wait_credit when
         a backpressure window is set. Mirrors the cluster worker's
         _stream_items with in-process stores."""
+        self._record(state.task_id, state.name, "RUNNING")
+        with tracing.task_context(state.task_id.hex(), None):
+            self._drive_stream_impl(state, produce, chaos_key)
+        self._record(
+            state.task_id, state.name,
+            "FAILED" if state.error is not None else "FINISHED",
+            args={"stream_items": state.count},
+        )
+
+    def _drive_stream_impl(self, state: StreamState, produce, chaos_key: str):
         try:
             result = produce()
         except chaos.ChaosKilled:
@@ -359,6 +420,8 @@ class LocalBackend(Backend):
 
     def _submit_streaming_task(self, func, args, kwargs, options):
         state = self._make_stream(options, getattr(func, "__name__", "task"))
+        self._record(state.task_id, state.name, "SUBMITTED",
+                     parent_id=tracing.current_task_id())
 
         def produce():
             rargs, rkwargs = self._resolve_args(args, kwargs)
@@ -375,6 +438,8 @@ class LocalBackend(Backend):
     def _submit_streaming_actor_task(self, actor_id, method_name, args,
                                      kwargs, options):
         state = self._make_stream(options, method_name)
+        self._record(state.task_id, method_name, "SUBMITTED",
+                     actor_id=actor_id, parent_id=tracing.current_task_id())
         actor = self._actors.get(actor_id)
         if actor is None or actor.dead:
             state.fail(exc.ActorDiedError(
@@ -423,6 +488,11 @@ class LocalBackend(Backend):
             ObjectRef(ObjectID.for_task_return(task_id, i), task_id=task_id)
             for i in range(max(1, options.num_returns))
         ]
+        name = getattr(func, "__name__", "task")
+        trace_id = tracing.current_trace_id()
+        parent_id = tracing.current_task_id()
+        self._record(task_id, name, "SUBMITTED", trace_id=trace_id,
+                     parent_id=parent_id)
 
         def run():
             retries = (
@@ -431,21 +501,27 @@ class LocalBackend(Backend):
                 else 0 if not options.retry_exceptions else 3
             )
             attempt = 0
-            while True:
-                if task_id in self._cancelled:
-                    self._store_error(refs, exc.TaskCancelledError(task_id))
-                    return
-                try:
-                    rargs, rkwargs = self._resolve_args(args, kwargs)
-                    result = func(*rargs, **rkwargs)
-                    self._store_results(refs, result, options.num_returns)
-                    return
-                except Exception as e:  # noqa: BLE001 - user exception boundary
-                    attempt += 1
-                    if options.retry_exceptions and attempt <= retries:
-                        continue
-                    self._store_error(refs, e)
-                    return
+            with tracing.task_context(task_id.hex(), trace_id):
+                self._record(task_id, name, "RUNNING", trace_id=trace_id)
+                while True:
+                    if task_id in self._cancelled:
+                        self._store_error(refs, exc.TaskCancelledError(task_id))
+                        self._record(task_id, name, "FAILED", trace_id=trace_id)
+                        return
+                    try:
+                        rargs, rkwargs = self._resolve_args(args, kwargs)
+                        result = func(*rargs, **rkwargs)
+                        self._store_results(refs, result, options.num_returns)
+                        self._record(task_id, name, "FINISHED",
+                                     trace_id=trace_id)
+                        return
+                    except Exception as e:  # noqa: BLE001 - user exception boundary
+                        attempt += 1
+                        if options.retry_exceptions and attempt <= retries:
+                            continue
+                        self._store_error(refs, e)
+                        self._record(task_id, name, "FAILED", trace_id=trace_id)
+                        return
 
         threading.Thread(target=run, daemon=True, name=f"task-{task_id.hex()[:8]}").start()
         return refs
@@ -494,6 +570,10 @@ class LocalBackend(Backend):
             return refs
 
         actor.pending_refs.update(refs)
+        trace_id = tracing.current_trace_id()
+        parent_id = tracing.current_task_id()
+        self._record(task_id, method_name, "SUBMITTED", actor_id=actor_id,
+                     trace_id=trace_id, parent_id=parent_id)
 
         def run():
             _current_actor.actor_id = actor_id
@@ -501,35 +581,42 @@ class LocalBackend(Backend):
                 from ray_tpu.actor import CGRAPH_CALL_METHOD
 
                 actor.ensure_initialized()
-                rargs, rkwargs = self._resolve_args(args, kwargs)
-                # chaos injection point "actor.call": an active plan can kill
-                # this actor at the Nth matching call (before user code runs,
-                # like a worker SIGKILL racing the dispatch)
-                act = chaos.fire(
-                    "actor.call",
-                    key=f"{type(actor.instance).__name__}.{method_name}",
-                )
-                if act is not None and act.get("action") == "kill":
-                    chaos.perform_kill_self(
-                        f"chaos kill at {method_name}"
-                    )  # raises ChaosKilled after _fail_actor
-                if method_name == CGRAPH_CALL_METHOD:
-                    # generic entry point: fn(instance, *args) — compiled
-                    # graph loops and other framework code on user actors
-                    fn, rargs = rargs[0], rargs[1:]
-                    result = fn(actor.instance, *rargs, **rkwargs)
-                else:
-                    method = getattr(actor.instance, method_name)
-                    result = method(*rargs, **rkwargs)
-                import inspect
+                with tracing.task_context(task_id.hex(), trace_id):
+                    self._record(task_id, method_name, "RUNNING",
+                                 actor_id=actor_id, trace_id=trace_id)
+                    rargs, rkwargs = self._resolve_args(args, kwargs)
+                    # chaos injection point "actor.call": an active plan can kill
+                    # this actor at the Nth matching call (before user code runs,
+                    # like a worker SIGKILL racing the dispatch)
+                    act = chaos.fire(
+                        "actor.call",
+                        key=f"{type(actor.instance).__name__}.{method_name}",
+                    )
+                    if act is not None and act.get("action") == "kill":
+                        chaos.perform_kill_self(
+                            f"chaos kill at {method_name}"
+                        )  # raises ChaosKilled after _fail_actor
+                    if method_name == CGRAPH_CALL_METHOD:
+                        # generic entry point: fn(instance, *args) — compiled
+                        # graph loops and other framework code on user actors
+                        fn, rargs = rargs[0], rargs[1:]
+                        result = fn(actor.instance, *rargs, **rkwargs)
+                    else:
+                        method = getattr(actor.instance, method_name)
+                        result = method(*rargs, **rkwargs)
+                    import inspect
 
-                if inspect.iscoroutine(result):
-                    import asyncio
+                    if inspect.iscoroutine(result):
+                        import asyncio
 
-                    result = asyncio.run(result)
+                        result = asyncio.run(result)
                 self._store_results(refs, result, options.num_returns)
+                self._record(task_id, method_name, "FINISHED",
+                             actor_id=actor_id, trace_id=trace_id)
             except Exception as e:  # noqa: BLE001
                 self._store_error(refs, e)
+                self._record(task_id, method_name, "FAILED",
+                             actor_id=actor_id, trace_id=trace_id)
             finally:
                 _current_actor.actor_id = None
                 actor.pending_refs.difference_update(refs)
@@ -557,6 +644,9 @@ class LocalBackend(Backend):
                     fut = self._future_for(r.id)
                     if not fut.done():
                         fut.set_result(err)
+                        if r.task_id is not None:
+                            self._record(r.task_id, "", "FAILED",
+                                         actor_id=actor_id)
 
             actor.stop(resolve_pending=resolve)
             with self._lock:
@@ -686,11 +776,23 @@ class LocalBackend(Backend):
                 {"actor_id": aid.binary(), "state": "ALIVE"}
                 for aid, a in self._actors.items()
             ]
-        if method in ("list_tasks", "list_placement_groups", "object_stats"):
+        if method == "list_tasks":
+            return self._sync_events().list_tasks(kwargs.get("limit", 1000))
+        if method == "get_task":
+            return self._sync_events().get_task(kwargs["task_id"])
+        if method == "summarize_tasks":
+            return self._sync_events().summarize()
+        if method == "timeline_events":
+            return self._sync_events().timeline_events(
+                kwargs.get("limit", 50_000)
+            )
+        if method in ("list_placement_groups", "object_stats"):
             return []
         if method == "get_metrics":
-            return {"num_nodes": 1, "num_alive_nodes": 1,
-                    "num_actors": len(self._actors)}
+            m = {"num_nodes": 1, "num_alive_nodes": 1,
+                 "num_actors": len(self._actors)}
+            m.update(self._sync_events().stats())
+            return m
         if method == "collect_metrics":
             # local mode: everything runs in-process, so the local registry
             # IS the cluster-wide view

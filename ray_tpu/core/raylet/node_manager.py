@@ -20,6 +20,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from ray_tpu import tracing
 from ray_tpu.core import rpc
 from ray_tpu.core.config import _config
 from ray_tpu.core.ids import ObjectID
@@ -51,6 +52,11 @@ class LeaseRequest:
     bundle_index: int = -1
     owner_conn: object = None
     req_id: Optional[str] = None   # owner-side id for cancellation
+    # tracing: identity of the task that triggered the request, so the
+    # grant records a LEASED event (cached-lease reuse skips the raylet)
+    task_id: Optional[str] = None
+    task_name: str = ""
+    trace_id: Optional[str] = None
 
 
 class Raylet:
@@ -110,6 +116,7 @@ class Raylet:
     # ------------------------------------------------------------ lifecycle
     async def start(self):
         await self.server.start()
+        tracing.get_buffer().set_identity(self.node_id, self.server.address)
         worker_env = dict(self.worker_env)
         if not self.total.get("TPU"):
             # TPU-less node: pin workers to the CPU backend EXPLICITLY.
@@ -162,6 +169,7 @@ class Raylet:
             asyncio.create_task(self.log_monitor.run(self._publish_logs))
         )
         self._bg.append(asyncio.create_task(self._metrics_flush_loop()))
+        self._bg.append(asyncio.create_task(self._task_events_flush_loop()))
         if _config.enable_worker_prestart:
             n = min(2, int(self.total.get("CPU")) or 1)
             for _ in range(n):
@@ -309,6 +317,15 @@ class Raylet:
                 logger.exception("metrics flush error")
             await asyncio.sleep(period)
 
+    async def _task_events_flush_loop(self):
+        """Flush this raylet's task events (lease grants) to the GCS
+        aggregator — same plane the workers/drivers flush on. notify (not
+        call): the raylet must never block on a GCS reply."""
+        await tracing.events.flush_task_events_loop(
+            tracing.get_buffer(), lambda: self.gcs,
+            source=f"raylet-{self.node_id}", use_notify=True,
+        )
+
     # ----------------------------------------------------------- scheduling
     def handle_worker_blocked(self, conn, worker_id: str):
         """A leased worker is blocking in get(): release its lease's
@@ -362,7 +379,8 @@ class Raylet:
 
     async def handle_request_lease(
         self, conn, resources, allow_spillback=True, pg_id=None,
-        bundle_index=-1, req_id=None,
+        bundle_index=-1, req_id=None, task_id=None, task_name="",
+        trace_id=None,
     ):
         """Owner asks for a worker lease. Replies:
         {granted: worker_addr, lease_id} | {spillback: raylet_addr} |
@@ -386,6 +404,9 @@ class Raylet:
             bundle_index=bundle_index,
             owner_conn=conn,
             req_id=req_id,
+            task_id=task_id,
+            task_name=task_name or "",
+            trace_id=trace_id,
         )
         self.pending_leases.append(lease)
         await self._dispatch()
@@ -536,6 +557,13 @@ class Raylet:
                 {"granted": worker.address, "lease_id": lease.lease_id,
                  "worker_id": worker.worker_id}
             )
+            if lease.task_id is not None:
+                tracing.get_buffer().record(
+                    task_id=lease.task_id, name=lease.task_name,
+                    state="LEASED", node_id=self.node_id,
+                    worker=worker.address, trace_id=lease.trace_id,
+                    component="raylet",
+                )
             logger.debug("lease %s granted -> %s", lease.lease_id[:8], worker.address)
             # chaos: a plan may kill the worker at the Nth granted lease;
             # poll_deaths reaps it and the owner's retry path takes over

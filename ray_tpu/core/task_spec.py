@@ -56,6 +56,13 @@ class TaskSpec:
     # in the reply; `backpressure` bounds the producer's unconsumed lead
     streaming: bool = False
     backpressure: Optional[int] = None
+    # tracing (ray_tpu/tracing/): one trace id per logical request,
+    # propagated into every nested submission so a request stitches across
+    # processes; parent_task_id is the submitting task (hex), attempt counts
+    # owner-side retries (mutated before each resubmission)
+    trace_id: Optional[str] = None
+    parent_task_id: Optional[str] = None
+    attempt: int = 0
 
     def return_refs(self) -> List[ObjectRef]:
         return [

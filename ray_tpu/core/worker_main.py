@@ -20,6 +20,7 @@ from typing import Dict, Optional
 import cloudpickle
 
 from ray_tpu import exceptions as exc
+from ray_tpu import tracing
 from ray_tpu.core import rpc, serialization, task_spec as ts
 from ray_tpu.core.config import _config
 from ray_tpu.core.core_worker import CoreWorker
@@ -114,30 +115,40 @@ class WorkerAgent(CoreWorker):
         finally:
             self._notify_blocked(False)
 
+    def _task_ctx(self, spec: ts.TaskSpec):
+        """Tracing context for the executing task: nested submissions made
+        by the user function inherit this task as parent and ride the
+        request's trace id (propagated through the spec)."""
+        return tracing.task_context(
+            spec.task_id.hex(), getattr(spec, "trace_id", None)
+        )
+
     def _execute(self, spec: ts.TaskSpec) -> dict:
         applied = False
         self._record_task_event(spec, "RUNNING")
         try:
-            if spec.runtime_env:
-                # mark BEFORE apply: a partial apply (missing package, GCS
-                # hiccup) must still be rolled back by the finally-reset
-                applied = True
-                self._env_applier().apply(spec.runtime_env)
-            fn = self.io.run(self.load_function(spec.fn_id))
-            args, kwargs = ts.decode_args(
-                spec.args, spec.kwargs,
-                lambda refs: self.get_blocking(refs, None),
-            )
-            attempts = 0
-            while True:
-                try:
-                    result = fn(*args, **kwargs)
-                    break
-                except Exception as e:  # noqa: BLE001 - user exception
-                    attempts += 1
-                    if spec.retry_exceptions and attempts <= spec.max_retries:
-                        continue
-                    return self._attach_borrows(spec, self._error_result(spec, e))
+            with self._task_ctx(spec):
+                if spec.runtime_env:
+                    # mark BEFORE apply: a partial apply (missing package, GCS
+                    # hiccup) must still be rolled back by the finally-reset
+                    applied = True
+                    self._env_applier().apply(spec.runtime_env)
+                fn = self.io.run(self.load_function(spec.fn_id))
+                args, kwargs = ts.decode_args(
+                    spec.args, spec.kwargs,
+                    lambda refs: self.get_blocking(refs, None),
+                )
+                attempts = 0
+                while True:
+                    try:
+                        result = fn(*args, **kwargs)
+                        break
+                    except Exception as e:  # noqa: BLE001 - user exception
+                        attempts += 1
+                        if spec.retry_exceptions and attempts <= spec.max_retries:
+                            continue
+                        return self._attach_borrows(spec, self._error_result(spec, e))
+            self._record_task_event(spec, "EXECUTED")
             return self._attach_borrows(spec, self._success_result(spec, result))
         except exc.RayTpuError as e:
             return self._attach_borrows(spec, self._error_result(spec, e, system=True))
@@ -278,16 +289,17 @@ class WorkerAgent(CoreWorker):
             if spec.runtime_env:
                 applied = True
                 self._env_applier().apply(spec.runtime_env)
-            fn = self.io.run(self.load_function(spec.fn_id))
-            args, kwargs = ts.decode_args(
-                spec.args, spec.kwargs,
-                lambda refs: self.get_blocking(refs, None),
-            )
-            return self._stream_items(
-                spec, conn,
-                lambda: fn(*args, **kwargs),
-                chaos_key=spec.name,
-            )
+            with self._task_ctx(spec):
+                fn = self.io.run(self.load_function(spec.fn_id))
+                args, kwargs = ts.decode_args(
+                    spec.args, spec.kwargs,
+                    lambda refs: self.get_blocking(refs, None),
+                )
+                return self._stream_items(
+                    spec, conn,
+                    lambda: fn(*args, **kwargs),
+                    chaos_key=spec.name,
+                )
         except exc.RayTpuError as e:
             return self._attach_borrows(spec, self._error_result(spec, e, system=True))
         except BaseException as e:  # noqa: BLE001
@@ -310,13 +322,14 @@ class WorkerAgent(CoreWorker):
             act = chaos.fire("actor.call", key=key)
             if act is not None and act.get("action") == "kill":
                 chaos.perform_kill_self(f"chaos kill at {spec.actor_method}")
-            args, kwargs = ts.decode_args(
-                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
-            )
-            method = getattr(self.actor_instance, spec.actor_method)
-            return self._stream_items(
-                spec, conn, lambda: method(*args, **kwargs), chaos_key=key
-            )
+            with self._task_ctx(spec):
+                args, kwargs = ts.decode_args(
+                    spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+                )
+                method = getattr(self.actor_instance, spec.actor_method)
+                return self._stream_items(
+                    spec, conn, lambda: method(*args, **kwargs), chaos_key=key
+                )
         except BaseException as e:  # noqa: BLE001
             return self._attach_borrows(spec, self._error_result(spec, e))
 
@@ -463,6 +476,12 @@ class WorkerAgent(CoreWorker):
         return self._stream_reply(spec, produced, had_error, granted)
 
     def _stream_reply(self, spec, total, had_error, granted) -> dict:
+        # tracing: one worker-side end-of-production event per stream (NOT
+        # per item — pushes are the hot path) carrying the item count
+        self._record_task_event(
+            spec, "EXECUTED",
+            args={"stream_items": total, "stream_error": bool(had_error)},
+        )
         out = {"results": [("streamed", {"total": total, "error": had_error})]}
         if granted:
             out["granted"] = granted
@@ -570,21 +589,23 @@ class WorkerAgent(CoreWorker):
             )
             if act is not None and act.get("action") == "kill":
                 chaos.perform_kill_self(f"chaos kill at {spec.actor_method}")
-            args, kwargs = ts.decode_args(
-                spec.args, spec.kwargs, lambda refs: self.get(refs, None)
-            )
-            if spec.actor_method == CGRAPH_CALL_METHOD:
-                # generic entry point: fn(instance, *args) — compiled graph
-                # loops and other framework code on user actors
-                fn, args = args[0], args[1:]
-                result = fn(self.actor_instance, *args, **kwargs)
-            else:
-                method = getattr(self.actor_instance, spec.actor_method)
-                result = method(*args, **kwargs)
-            import inspect
+            with self._task_ctx(spec):
+                args, kwargs = ts.decode_args(
+                    spec.args, spec.kwargs, lambda refs: self.get(refs, None)
+                )
+                if spec.actor_method == CGRAPH_CALL_METHOD:
+                    # generic entry point: fn(instance, *args) — compiled graph
+                    # loops and other framework code on user actors
+                    fn, args = args[0], args[1:]
+                    result = fn(self.actor_instance, *args, **kwargs)
+                else:
+                    method = getattr(self.actor_instance, spec.actor_method)
+                    result = method(*args, **kwargs)
+                import inspect
 
-            if inspect.iscoroutine(result):
-                result = asyncio.run(result)
+                if inspect.iscoroutine(result):
+                    result = asyncio.run(result)
+            self._record_task_event(spec, "EXECUTED")
             return self._attach_borrows(spec, self._success_result(spec, result))
         except BaseException as e:  # noqa: BLE001
             return self._attach_borrows(spec, self._error_result(spec, e))

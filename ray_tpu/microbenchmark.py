@@ -162,6 +162,9 @@ def main(duration: float = 2.0):
     _stream_benchmarks(ray_tpu, results, "local", duration)
     ray_tpu.shutdown()
 
+    # ----------------------------------------------------- tracing overhead
+    _tracing_overhead_benchmarks(ray_tpu, results, duration)
+
     print(json.dumps({"microbenchmark": results}))
     return results
 
@@ -221,6 +224,59 @@ def _stream_benchmarks(ray_tpu, results, mode: str, duration: float):
 
     results.append(timeit(
         f"stream chunks push generator ({mode})", push_chunks, duration))
+
+
+def _tracing_overhead_benchmarks(ray_tpu, results, duration: float):
+    """Dispatch throughput with the task-event plane (ray_tpu/tracing/) off,
+    sampled, and fully on. Each pass boots a fresh cluster with the config
+    exported through the environment, so WORKERS record (or skip) events
+    too, not just the driver — the honest end-to-end overhead. The PR-4
+    acceptance bar: full tracing costs <10% of dispatch throughput, off
+    costs ~0."""
+    import os
+
+    from ray_tpu.core.config import _config
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("RAY_TPU_TASK_EVENTS_ENABLED",
+                  "RAY_TPU_TASK_EVENTS_SAMPLE_RATE")
+    }
+    saved_cfg = (_config.task_events_enabled, _config.task_events_sample_rate)
+    try:
+        for label, enabled, rate in (
+            ("off", False, 1.0), ("sampled 10%", True, 0.1),
+            ("full", True, 1.0),
+        ):
+            os.environ["RAY_TPU_TASK_EVENTS_ENABLED"] = "1" if enabled else "0"
+            os.environ["RAY_TPU_TASK_EVENTS_SAMPLE_RATE"] = str(rate)
+            _config.task_events_enabled = enabled
+            _config.task_events_sample_rate = rate
+            ray_tpu.init(num_cpus=4, num_tpus=0)
+
+            @ray_tpu.remote
+            def noop():
+                return 0
+
+            ray_tpu.get([noop.remote() for _ in range(16)])  # warm the pool
+
+            def batch():
+                n = 50
+                ray_tpu.get([noop.remote() for _ in range(n)])
+                return n
+
+            results.append(timeit(
+                f"task dispatch (50 in flight), tracing {label}", batch,
+                duration,
+            ))
+            ray_tpu.shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _config.task_events_enabled, _config.task_events_sample_rate = saved_cfg
 
 
 if __name__ == "__main__":

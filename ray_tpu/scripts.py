@@ -142,6 +142,15 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Export the cluster's task-event timeline as Chrome-trace JSON
+    (open in chrome://tracing or Perfetto)."""
+    ray = _connect(args)
+    events = ray.timeline(args.out)
+    print(f"wrote {len(events)} trace events to {args.out}")
+    return 0
+
+
 def cmd_job_submit(args) -> int:
     ray = _connect(args)
     from ray_tpu.job_submission import JobSubmissionClient
@@ -200,6 +209,11 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("microbenchmark", help="core op/s microbenchmarks")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("timeline", help="export Chrome-trace task timeline")
+    p.add_argument("--address")
+    p.add_argument("--out", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", required=True, help="GCS address host:port")

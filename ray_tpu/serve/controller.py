@@ -106,6 +106,12 @@ class ServeController:
                     for name, d in self._deployments.items()
                     if getattr(d, "request_timeout_s", None) is not None
                 },
+                "stream_backpressure": {
+                    name: d.stream_backpressure_window
+                    for name, d in self._deployments.items()
+                    if getattr(d, "stream_backpressure_window", None)
+                    is not None
+                },
             }
 
     def status(self) -> dict:

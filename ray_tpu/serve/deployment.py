@@ -36,6 +36,10 @@ class Deployment:
     # None = _config.serve_request_timeout_s. Propagates through the routing
     # table so every handle/proxy honors it.
     request_timeout_s: Optional[float] = None
+    # per-deployment streaming backpressure window: bound on a replica's
+    # unconsumed chunk lead over a slow client (None = routed default, 16).
+    # Propagates through the routing table; handle.options() can override.
+    stream_backpressure_window: Optional[int] = None
 
     def options(self, **kwargs) -> "Deployment":
         return replace(self, **kwargs)
@@ -74,6 +78,7 @@ def deployment(
     autoscaling_config: Optional[Any] = None,
     route_prefix: Optional[str] = None,
     request_timeout_s: Optional[float] = None,
+    stream_backpressure_window: Optional[int] = None,
 ):
     """@serve.deployment — wraps a class or function into a Deployment."""
 
@@ -91,6 +96,7 @@ def deployment(
             autoscaling_config=ac,
             route_prefix=route_prefix,
             request_timeout_s=request_timeout_s,
+            stream_backpressure_window=stream_backpressure_window,
         )
 
     if _func_or_class is not None:

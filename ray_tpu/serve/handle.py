@@ -28,9 +28,14 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import exceptions as exc
+from ray_tpu import tracing
 from ray_tpu.core.config import _config
 
 logger = logging.getLogger(__name__)
+
+# routed streaming default: bound on the replica's unconsumed lead when the
+# deployment doesn't set stream_backpressure_window
+DEFAULT_STREAM_BACKPRESSURE = 16
 
 
 class Router:
@@ -40,6 +45,8 @@ class Router:
         self._replicas: Dict[str, List[Any]] = {}
         self._routes: Dict[str, str] = {}
         self._timeouts: Dict[str, float] = {}  # per-deployment request timeout
+        # per-deployment stream backpressure window (routing-table propagated)
+        self._backpressures: Dict[str, int] = {}
         # dep → replica-id bytes → in-flight count (keyed by stable
         # replica identity, NOT list position: eviction reshuffles indices)
         self._inflight: Dict[str, Dict[bytes, int]] = {}
@@ -72,6 +79,10 @@ class Router:
                 k: v for k, v in (table.get("timeouts") or {}).items()
                 if v is not None
             }
+            self._backpressures = {
+                k: v for k, v in (table.get("stream_backpressure") or {}).items()
+                if v is not None
+            }
             for name, replicas in self._replicas.items():
                 old = self._inflight.get(name, {})
                 # carry live counts across refreshes; drop dead replicas'
@@ -91,6 +102,14 @@ class Router:
             self._refresh()
         return self._timeouts.get(deployment) or _config.serve_request_timeout_s
 
+    def backpressure_for(self, deployment: str) -> int:
+        """Effective stream backpressure window: the deployment's
+        stream_backpressure_window (routing-table propagated) or the
+        routed-streaming default."""
+        if deployment not in self._backpressures:
+            self._refresh()
+        return self._backpressures.get(deployment) or DEFAULT_STREAM_BACKPRESSURE
+
     def assign_request(self, deployment: str, *args, **kwargs):
         """Route one request; returns an ObjectRef. When the backend
         supports deferred refs, the returned ref is fulfilled by a retry
@@ -98,23 +117,32 @@ class Router:
         retry on a healthy replica) instead of ActorDiedError."""
         from ray_tpu.api import _global_worker
 
-        ref, replica = self.assign_request_with_replica(
-            deployment, *args, **kwargs
-        )
-        deferred = (
-            _global_worker().backend.create_deferred()
-            if _config.serve_request_retries > 0 else None
-        )
-        if deferred is None:  # retries disabled / no deferred-ref support
-            return ref
-        out_ref, fulfill = deferred
-        self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
-                           attempt=0)
-        return out_ref
+        # tracing: one trace id per request (kept when the caller — e.g. an
+        # upstream replica in a composed app — already runs inside one), so
+        # the handle span, the replica's task events, and any nested
+        # deployment calls stitch into a single cross-process trace
+        with tracing.ensure_trace() as trace_id:
+            tracing.get_buffer().record_profile(
+                "serve.request", component="serve",
+                args={"deployment": deployment},
+            )
+            ref, replica = self.assign_request_with_replica(
+                deployment, *args, **kwargs
+            )
+            deferred = (
+                _global_worker().backend.create_deferred()
+                if _config.serve_request_retries > 0 else None
+            )
+            if deferred is None:  # retries disabled / no deferred-ref support
+                return ref
+            out_ref, fulfill = deferred
+            self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
+                               attempt=0, trace_id=trace_id)
+            return out_ref
 
     # ------------------------------------------------------------- failover
     def _arm_failover(self, deployment, ref, replica, args, kwargs, fulfill,
-                      attempt: int):
+                      attempt: int, trace_id: Optional[str] = None):
         from ray_tpu.api import _global_worker
 
         # success-path passthrough: when the backend can hand us the
@@ -131,7 +159,8 @@ class Router:
                 self._on_replica_failure(deployment, replica)
                 if attempt < _config.serve_request_retries:
                     self._enqueue_retry(
-                        deployment, args, kwargs, fulfill, attempt + 1
+                        deployment, args, kwargs, fulfill, attempt + 1,
+                        trace_id,
                     )
                 else:
                     fulfill(error=e)
@@ -150,7 +179,8 @@ class Router:
         except Exception as e:  # noqa: BLE001 - no future support
             fulfill(error=e)
 
-    def _enqueue_retry(self, deployment, args, kwargs, fulfill, attempt):
+    def _enqueue_retry(self, deployment, args, kwargs, fulfill, attempt,
+                       trace_id=None):
         with self._lock:
             if self._retry_thread is None:
                 self._retry_thread = threading.Thread(
@@ -158,25 +188,31 @@ class Router:
                     name="serve-router-retry",
                 )
                 self._retry_thread.start()
-        self._retry_queue.put((deployment, args, kwargs, fulfill, attempt))
+        self._retry_queue.put(
+            (deployment, args, kwargs, fulfill, attempt, trace_id)
+        )
 
     def _retry_worker(self):
         while True:
-            deployment, args, kwargs, fulfill, attempt = self._retry_queue.get()
+            (deployment, args, kwargs, fulfill, attempt,
+             trace_id) = self._retry_queue.get()
             self.retry_count += 1
             logger.warning(
                 "serve: retrying request to %r on a healthy replica "
                 "(attempt %d)", deployment, attempt,
             )
             try:
-                ref, replica = self.assign_request_with_replica(
-                    deployment, *args, **kwargs
-                )
+                # the retry dispatch keeps riding the original request's
+                # trace (the retry thread has no inherited context)
+                with tracing.trace_context(trace_id or tracing.new_trace_id()):
+                    ref, replica = self.assign_request_with_replica(
+                        deployment, *args, **kwargs
+                    )
             except BaseException as e:  # noqa: BLE001 - no replicas left
                 fulfill(error=e)
                 continue
             self._arm_failover(deployment, ref, replica, args, kwargs,
-                               fulfill, attempt)
+                               fulfill, attempt, trace_id)
 
     def _on_replica_failure(self, deployment: str, replica) -> None:
         """Evict a dead replica from the local routing set NOW (the next
@@ -214,18 +250,23 @@ class Router:
         kwargs = kwargs or {}
         timeout = timeout if timeout is not None else self.timeout_for(deployment)
         attempt = 0
-        while True:
-            ref, replica = self.assign_request_with_replica(
-                deployment, *args, **kwargs
+        with tracing.ensure_trace():
+            tracing.get_buffer().record_profile(
+                "serve.request", component="serve",
+                args={"deployment": deployment},
             )
-            try:
-                return ray_tpu.get(ref, timeout=timeout), replica
-            except (exc.ActorDiedError, exc.ActorUnavailableError):
-                self._on_replica_failure(deployment, replica)
-                attempt += 1
-                if attempt > _config.serve_request_retries:
-                    raise
-                self.retry_count += 1
+            while True:
+                ref, replica = self.assign_request_with_replica(
+                    deployment, *args, **kwargs
+                )
+                try:
+                    return ray_tpu.get(ref, timeout=timeout), replica
+                except (exc.ActorDiedError, exc.ActorUnavailableError):
+                    self._on_replica_failure(deployment, replica)
+                    attempt += 1
+                    if attempt > _config.serve_request_retries:
+                        raise
+                    self.retry_count += 1
 
     def wait_for_replicas(self, deployment: str, timeout: float = 30.0):
         """Block until the deployment has live replicas; returns the list
@@ -274,7 +315,7 @@ class Router:
 
     def stream_request(self, deployment: str, args=(), kwargs=None,
                        timeout: Optional[float] = None,
-                       backpressure: Optional[int] = 16):
+                       backpressure: Optional[int] = None):
         """Push-based streaming dispatch (ray_tpu/streaming/): invoke the
         replica's generator entry point with ``num_returns="streaming"`` and
         return ``(header, gen, replica)`` once the header item arrived —
@@ -286,32 +327,41 @@ class Router:
         to its replica (generator state lives there), so a mid-stream death
         raises on the next item. `backpressure` bounds the replica's
         unconsumed lead (slow clients must not buffer the whole response
-        replica-side)."""
+        replica-side); None resolves the deployment's
+        ``stream_backpressure_window`` (routing-table propagated, handle
+        ``options()`` overridable) and finally the routed default."""
         import ray_tpu
 
         kwargs = kwargs or {}
         timeout = timeout if timeout is not None else self.timeout_for(deployment)
+        if backpressure is None:
+            backpressure = self.backpressure_for(deployment)
         attempt = 0
-        while True:
-            replica, rkey = self._pick_replica(deployment)
-            gen = replica.handle_request_streaming.options(
-                num_returns="streaming",
-                generator_backpressure_num_objects=backpressure,
-            ).remote(*args, **kwargs)
-            try:
-                header = ray_tpu.get(gen.next_ref(timeout), timeout=timeout)
-                self._dec_inflight(deployment, rkey)
-                return header, gen, replica
-            except (exc.ActorDiedError, exc.ActorUnavailableError):
-                self._dec_inflight(deployment, rkey)
-                self._on_replica_failure(deployment, replica)
-                attempt += 1
-                if attempt > _config.serve_request_retries:
+        with tracing.ensure_trace() as trace_id:
+            tracing.get_buffer().record_profile(
+                "serve.stream", component="serve",
+                args={"deployment": deployment, "backpressure": backpressure},
+            )
+            while True:
+                replica, rkey = self._pick_replica(deployment)
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming",
+                    generator_backpressure_num_objects=backpressure,
+                ).remote(*args, **kwargs)
+                try:
+                    header = ray_tpu.get(gen.next_ref(timeout), timeout=timeout)
+                    self._dec_inflight(deployment, rkey)
+                    return header, gen, replica
+                except (exc.ActorDiedError, exc.ActorUnavailableError):
+                    self._dec_inflight(deployment, rkey)
+                    self._on_replica_failure(deployment, replica)
+                    attempt += 1
+                    if attempt > _config.serve_request_retries:
+                        raise
+                    self.retry_count += 1
+                except BaseException:
+                    self._dec_inflight(deployment, rkey)
                     raise
-                self.retry_count += 1
-            except BaseException:
-                self._dec_inflight(deployment, rkey)
-                raise
 
     def _dec_inflight(self, deployment: str, rkey: bytes) -> None:
         with self._lock:
@@ -341,16 +391,26 @@ class DeploymentHandle:
     or ``_config.serve_request_timeout_s``."""
 
     def __init__(self, deployment_name: str, router: Router,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 stream_backpressure_window: Optional[int] = None):
         self.deployment_name = deployment_name
         self._router = router
         self._timeout_s = timeout_s
+        self._stream_backpressure_window = stream_backpressure_window
 
-    def options(self, *, timeout_s: Optional[float] = None) -> "DeploymentHandle":
-        """Per-handle overrides (currently: request timeout)."""
+    def options(self, *, timeout_s: Optional[float] = None,
+                stream_backpressure_window: Optional[int] = None,
+                ) -> "DeploymentHandle":
+        """Per-handle overrides: request timeout and the streaming
+        backpressure window (bound on the replica's unconsumed lead)."""
         return DeploymentHandle(
             self.deployment_name, self._router,
             timeout_s=timeout_s if timeout_s is not None else self._timeout_s,
+            stream_backpressure_window=(
+                stream_backpressure_window
+                if stream_backpressure_window is not None
+                else self._stream_backpressure_window
+            ),
         )
 
     def _timeout(self) -> float:
@@ -393,7 +453,8 @@ class DeploymentHandle:
 
         timeout = self._timeout()
         header, gen, _replica = self._router.stream_request(
-            self.deployment_name, args, kwargs, timeout=timeout
+            self.deployment_name, args, kwargs, timeout=timeout,
+            backpressure=self._stream_backpressure_window,
         )
         streaming = isinstance(header, dict) and header.get("streaming")
         while True:

@@ -23,6 +23,33 @@ from typing import Callable, Optional
 from ray_tpu.core.ids import ObjectID, TaskID
 from ray_tpu.core.refs import ObjectRef
 
+# owner-buffer guard plane (shared by all streams in this process): live
+# over-cap unwindowed streams by identity → buffered count; the gauge
+# exports the MAX and drops to 0 once every backlog drains or closes
+_backlog_lock = threading.Lock()
+_backlogged: dict = {}
+_backlog_gauge = None
+
+
+def _update_backlog_gauge(state: "StreamState", buffered: int,
+                          over_cap: bool) -> None:
+    global _backlog_gauge
+    with _backlog_lock:
+        if over_cap:
+            _backlogged[id(state)] = buffered
+        else:
+            _backlogged.pop(id(state), None)
+        top = max(_backlogged.values(), default=0)
+    if _backlog_gauge is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _backlog_gauge = Gauge(
+            "streaming_owner_buffered_items",
+            "unconsumed pushed items buffered owner-side by the most "
+            "backlogged unwindowed stream",
+        )
+    _backlog_gauge.set(top)
+
 
 class EndOfStream(Exception):
     """Typed end-of-stream marker (internal wire/state use; consumers see
@@ -38,11 +65,18 @@ class StreamState:
         owner_addr: Optional[str] = None,
         window: Optional[int] = None,
         name: str = "stream",
+        explicit_window: bool = False,
     ):
         self.task_id = task_id
         self.owner_addr = owner_addr
         self.window = int(window) if window else None
         self.name = name
+        # False = the window is the implicit pipeline cap, not a user
+        # backpressure request: the owner-buffer guard below watches these
+        # streams (one-way notify pushes can briefly overrun the cap)
+        self.explicit_window = explicit_window
+        self._buffer_warned = False
+        self._was_backlogged = False
         self._cond = threading.Condition()
         self.count = 0            # items reported ready (max index + 1)
         self.consumed = 0         # items handed to the consumer
@@ -62,7 +96,40 @@ class StreamState:
         with self._cond:
             if index + 1 > self.count:
                 self.count = index + 1
+            buffered = self.count - self.consumed
             self._cond.notify_all()
+        self._guard_owner_buffer(buffered)
+
+    def _guard_owner_buffer(self, buffered: int) -> None:
+        """Owner-side guard for unconsumed pushed items (first slice of the
+        spill/bound roadmap item): export how far the most backlogged
+        stream's consumer is behind, and warn ONCE per stream when an
+        unwindowed stream overruns ``streaming_max_inflight_items`` (one-way
+        notify pushes can run ahead of the sync-point credit check).
+
+        Zero-cost for healthy streams: the gauge plane is touched only
+        while over the cap, plus once on the way back under so the export
+        recovers to the true maximum (not a stale last write)."""
+        if self.explicit_window:
+            return
+        from ray_tpu.core.config import _config
+
+        cap = max(1, _config.streaming_max_inflight_items)
+        over = buffered > cap
+        if not over and not self._was_backlogged:
+            return
+        self._was_backlogged = over
+        _update_backlog_gauge(self, buffered, over)
+        if over and not self._buffer_warned:
+            self._buffer_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "stream %r: %d unconsumed items buffered owner-side "
+                "(streaming_max_inflight_items=%d) — consumer is falling "
+                "behind; set generator_backpressure_num_objects to bound "
+                "the producer", self.name, buffered, cap,
+            )
 
     def finish(self, total: int) -> None:
         """Producer exhausted the generator after ``total`` items."""
@@ -163,8 +230,11 @@ class StreamState:
             if self.consumed < self.count:
                 i = self.consumed
                 self.consumed += 1
+                buffered = self.count - self.consumed
                 self._release_credit_locked()
                 self._cond.notify_all()  # credit for a blocked producer
+                if self._was_backlogged:  # draining: let the gauge recover
+                    self._guard_owner_buffer(buffered)
                 return i
             if self.error is not None:
                 raise self.error
@@ -185,6 +255,9 @@ class StreamState:
             self.closed = True
             self._release_credit_locked()
             self._cond.notify_all()
+        if self._was_backlogged:  # closed stream no longer counts as backlog
+            self._was_backlogged = False
+            _update_backlog_gauge(self, 0, False)
         cb = self._on_close
         if cb is not None:
             try:

@@ -1,0 +1,164 @@
+"""GCS-side task-event aggregation with bounded retention.
+
+Parity: src/ray/gcs/gcs_server/gcs_task_manager.h — per-task event storage
+with a global task cap (oldest-finished evicted first), per-task event caps,
+and drop counters surfaced as metrics. The same class backs local mode
+(the LocalBackend owns one and drains the process buffer into it on query).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.config import _config
+from ray_tpu.tracing import events as ev
+
+
+def _terminal_state(states: List[str]) -> Optional[str]:
+    # terminal verdicts are sticky: a RUNNING that flushes late (independent
+    # 1s flush loops in owner and worker) must never resurrect a task
+    if ev.FAILED in states:
+        return ev.FAILED
+    if ev.FINISHED in states:
+        return ev.FINISHED
+    return None
+
+
+class TaskEventAggregator:
+    """Bounded store of per-task event timelines + free-floating spans."""
+
+    def __init__(self, max_tasks: Optional[int] = None,
+                 max_events_per_task: int = 256,
+                 max_profile_events: int = 20_000):
+        self._lock = threading.Lock()
+        self._max_tasks = max_tasks or max(100, _config.task_events_max_tasks)
+        self._max_events_per_task = max_events_per_task
+        # task_id -> {"task_id", "name", "actor_id", "events": [...]}
+        self._tasks: "OrderedDict[str, dict]" = OrderedDict()
+        # spans with no task id (serve request spans, ad-hoc profile spans)
+        self._profile: deque = deque(maxlen=max_profile_events)
+        # drop accounting, surfaced as metrics
+        self._dropped_at_source: Dict[str, int] = {}  # source -> cumulative
+        self.evicted_tasks = 0
+        self.truncated_events = 0
+
+    # ------------------------------------------------------------- ingestion
+    def ingest(self, events: List[dict], dropped: int = 0,
+               source: Optional[str] = None) -> None:
+        with self._lock:
+            if source is not None and dropped:
+                # sources report a cumulative counter; max() is idempotent
+                prev = self._dropped_at_source.get(source, 0)
+                self._dropped_at_source[source] = max(prev, int(dropped))
+            for e in events:
+                tid = e.get("task_id")
+                if tid is None:
+                    self._profile.append(e)
+                    continue
+                rec = self._tasks.get(tid)
+                if rec is None:
+                    rec = self._tasks[tid] = {
+                        "task_id": tid,
+                        "name": e.get("name") or "",
+                        "actor_id": e.get("actor_id"),
+                        "events": [],
+                        "profile_count": 0,
+                    }
+                    self._evict_locked()
+                else:
+                    self._tasks.move_to_end(tid)
+                if not rec["name"] and e.get("name"):
+                    rec["name"] = e["name"]
+                if rec.get("actor_id") is None and e.get("actor_id"):
+                    rec["actor_id"] = e["actor_id"]
+                # the cap truncates PROFILE spans only: lifecycle events are
+                # intrinsically bounded (a handful per attempt) and dropping
+                # a terminal one would leave a phantom RUNNING state
+                if e.get("state") == ev.PROFILE:
+                    if rec["profile_count"] >= self._max_events_per_task:
+                        self.truncated_events += 1
+                        continue
+                    rec["profile_count"] += 1
+                rec["events"].append(e)
+
+    def _evict_locked(self) -> None:
+        while len(self._tasks) > self._max_tasks:
+            self._tasks.popitem(last=False)
+            self.evicted_tasks += 1
+
+    # --------------------------------------------------------------- queries
+    @staticmethod
+    def _latest(rec: dict) -> dict:
+        evs = sorted(rec["events"], key=lambda e: e.get("ts", 0))
+        states = [e["state"] for e in evs if e["state"] != ev.PROFILE]
+        state = _terminal_state(states) or (states[-1] if states else "UNKNOWN")
+        last = evs[-1] if evs else {}
+        return {
+            "task_id": rec["task_id"],
+            "name": rec["name"],
+            "state": state,
+            "actor_id": rec.get("actor_id"),
+            "node_id": last.get("node_id"),
+            "worker": last.get("worker"),
+            "trace_id": next(
+                (e["trace_id"] for e in evs if e.get("trace_id")), None
+            ),
+            "time": last.get("ts"),
+            "num_events": len(evs),
+        }
+
+    def get_task(self, task_id: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._tasks.get(task_id)
+            if rec is None:
+                return None
+            out = self._latest(rec)
+            out["events"] = sorted(
+                rec["events"], key=lambda e: e.get("ts", 0)
+            )
+            out["dropped_at_source"] = sum(self._dropped_at_source.values())
+            return out
+
+    def list_tasks(self, limit: int = 1000) -> List[dict]:
+        with self._lock:
+            recs = list(self._tasks.values())[-limit:]
+            return [self._latest(r) for r in recs]
+
+    def summarize(self) -> dict:
+        """Counts by function name and state (state-API summarize_tasks)."""
+        with self._lock:
+            by_name: Dict[str, Dict[str, int]] = {}
+            for rec in self._tasks.values():
+                row = self._latest(rec)
+                per = by_name.setdefault(row["name"] or "<unnamed>", {})
+                per[row["state"]] = per.get(row["state"], 0) + 1
+            return {
+                "tasks": by_name,
+                "total_tasks": len(self._tasks),
+                "dropped_at_source": sum(self._dropped_at_source.values()),
+                "evicted_tasks": self.evicted_tasks,
+                "truncated_events": self.truncated_events,
+            }
+
+    def timeline_events(self, limit: int = 50_000) -> List[dict]:
+        """Flat, time-sorted event list for Chrome-trace export."""
+        with self._lock:
+            out: List[dict] = []
+            for rec in self._tasks.values():
+                out.extend(rec["events"])
+            out.extend(self._profile)
+        out.sort(key=lambda e: e.get("ts", 0))
+        return out[-limit:]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "task_events_tasks": len(self._tasks),
+                "task_events_dropped_at_source": sum(
+                    self._dropped_at_source.values()
+                ),
+                "task_events_evicted_tasks": self.evicted_tasks,
+                "task_events_truncated": self.truncated_events,
+            }

@@ -36,8 +36,40 @@ def list_actors() -> List[Dict[str, Any]]:
     return out
 
 
+def _hex_ids(row: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize id fields to hex strings (list_actors already hexes;
+    task rows must match — no raw bytes escape the state API)."""
+    out = dict(row)
+    for k in ("task_id", "actor_id", "pg_id"):
+        if isinstance(out.get(k), bytes):
+            out[k] = out[k].hex()
+    return out
+
+
 def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
-    return _gcs_call("list_tasks", limit=limit)
+    """One row per task (latest state), ids hex-normalized."""
+    return [_hex_ids(t) for t in _gcs_call("list_tasks", limit=limit)]
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    """Full event timeline of one task from the tracing aggregator
+    (ray_tpu/tracing/): lifecycle transitions + profile spans, latest
+    state (terminal-sticky), and the sources' drop counter."""
+    if isinstance(task_id, bytes):
+        task_id = task_id.hex()
+    info = _gcs_call("get_task", task_id=task_id)
+    return _hex_ids(info) if info else None
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Task counts by function name and state, plus tracing drop/retention
+    counters (state-API summarize_tasks analog)."""
+    return _gcs_call("summarize_tasks")
+
+
+def timeline_events(limit: int = 50_000) -> List[Dict[str, Any]]:
+    """Flat task-event list backing ray_tpu.timeline()."""
+    return _gcs_call("timeline_events", limit=limit)
 
 
 def list_placement_groups() -> List[Dict[str, Any]]:
