@@ -25,7 +25,8 @@ constexpr int kRequest = 0;
 constexpr int kResponse = 1;
 constexpr int kError = 2;
 constexpr int kPush = 3;
-constexpr const char* kAuthMagic = "RAYTPU-AUTH1 ";
+// must track core/rpc.py PROTOCOL_VERSION (v2: segment-table frames)
+constexpr const char* kAuthMagic = "RAYTPU-AUTH2 ";
 }  // namespace
 
 struct Client::Impl {
@@ -51,9 +52,21 @@ struct Client::Impl {
     }
   }
 
+  // Raw frame: 8-byte length + body. The auth preamble uses this shape
+  // (the server reads it before any v2 parsing).
   void SendFrame(const std::string& payload) {
     std::string out = FrameHeader(payload.size()) + payload;
     SendAll(out.data(), out.size());
+  }
+
+  // v2 message frame: body = u32 nbuf + u64 size x nbuf + pickled message
+  // + raw out-of-band buffers. This thin client sends no OOB buffers
+  // (nbuf = 0) and its control payloads stay below the server's OOB
+  // threshold, so replies are expected in-band too.
+  void SendMessageFrame(const std::string& pickled) {
+    std::string body(4, '\0');  // u32 nbuf = 0
+    body += pickled;
+    SendFrame(body);
   }
 
   std::string RecvFrame() {
@@ -68,6 +81,22 @@ struct Client::Impl {
     return data;
   }
 
+  // Strip the v2 segment table off a received frame body, returning the
+  // pickled message. Out-of-band segments are not supported by this thin
+  // client's mini unpickler; control-plane replies never carry them.
+  std::string RecvMessageFrame() {
+    std::string body = RecvFrame();
+    if (body.size() < 4) throw std::runtime_error("ray_tpu: short frame");
+    uint32_t nbuf = 0;
+    for (int i = 0; i < 4; i++)
+      nbuf |= static_cast<uint32_t>(static_cast<unsigned char>(body[i])) << (8 * i);
+    if (nbuf != 0)
+      throw std::runtime_error(
+          "ray_tpu: reply carries out-of-band segments (unsupported by the "
+          "C++ thin client)");
+    return body.substr(4);
+  }
+
   // One request/response round-trip; PUSH frames are skipped (this thin
   // client subscribes to nothing).
   Value CallMethod(const std::string& method, ValueDict payload) {
@@ -76,9 +105,9 @@ struct Client::Impl {
     int64_t msg_id = next_id++;
     Value frame(ValueList{Value(static_cast<int64_t>(kRequest)), Value(msg_id),
                           Value(method), Value(std::move(payload))});
-    SendFrame(pickle::Encode(frame));
+    SendMessageFrame(pickle::Encode(frame));
     while (true) {
-      Value msg = pickle::Decode(RecvFrame());
+      Value msg = pickle::Decode(RecvMessageFrame());
       const ValueList& parts = msg.AsList();
       if (parts.size() != 4) throw std::runtime_error("ray_tpu: bad frame");
       int64_t type = parts[0].AsInt();

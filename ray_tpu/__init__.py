@@ -19,6 +19,7 @@ from ray_tpu.api import (
     kill,
     nodes,
     put,
+    put_many,
     remote,
     shutdown,
     wait,
@@ -40,6 +41,7 @@ __all__ = [
     "method",
     "get",
     "put",
+    "put_many",
     "wait",
     "kill",
     "cancel",

@@ -155,6 +155,18 @@ def put(value: Any) -> ObjectRef:
     return _worker.backend.put(value)
 
 
+def put_many(values: Sequence[Any]) -> List[ObjectRef]:
+    """Batched put: one bookkeeping sweep for the whole list (dispatch-plane
+    batching; the cluster backend coalesces location records into a single
+    flush). Semantically identical to ``[put(v) for v in values]``."""
+    values = list(values)
+    for v in values:
+        if isinstance(v, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+    _auto_init()
+    return list(_worker.backend.put_batch(values))
+
+
 def get(
     refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None
 ):

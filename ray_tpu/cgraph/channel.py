@@ -61,13 +61,37 @@ class ChannelTimeoutError(exc.GetTimeoutError):
     """A channel read/write did not complete within the timeout."""
 
 
-def _dumps(obj: Any) -> bytes:
+# buffers at least this large are written into the ring as out-of-band
+# segments (straight from their source memory) and, when the reader opts in
+# to zero-copy, mapped back as read-only views over the mmap
+_OOB_MIN = 1 << 12
+
+
+def _dumps_oob(obj: Any):
+    """Pickle ``obj`` splitting large buffers out-of-band.
+
+    Returns ``(payload, bufs)``: the in-band pickle stream plus the raw
+    source buffers (numpy data, bytes) to be written directly into the
+    ring after it — the write path never concatenates them."""
+    bufs = []
+
+    def cb(pb: pickle.PickleBuffer):
+        try:
+            raw = pb.raw()
+        except BufferError:  # non-contiguous: keep in-band
+            return True
+        if raw.nbytes < _OOB_MIN:
+            return True
+        bufs.append(raw)
+        return False
+
     try:
-        return pickle.dumps(obj, protocol=5)
+        return pickle.dumps(obj, protocol=5, buffer_callback=cb), bufs
     except Exception:  # noqa: BLE001 - closures, local classes
+        del bufs[:]
         import cloudpickle
 
-        return cloudpickle.dumps(obj)
+        return cloudpickle.dumps(obj, protocol=5, buffer_callback=cb), bufs
 
 
 class _Backoff:
@@ -92,6 +116,13 @@ class ShmChannel:
     def __init__(self, path: str, capacity: int = 1 << 20, max_msgs: int = 16,
                  create: bool = False):
         self.path = path
+        # Reader-side opt-in (compiled_dag sets it on the driver's output
+        # channels): large out-of-band payload buffers come back as
+        # READ-ONLY views over the ring's mmap instead of copies. A view is
+        # valid until the NEXT read on this channel (= the next execute()
+        # drained through it) — the read slot is released lazily.
+        self.zero_copy_reads = False
+        self._held_rpos: Optional[int] = None
         if create:
             with open(path, "w+b") as f:
                 f.truncate(_HDR + capacity)
@@ -133,15 +164,23 @@ class ShmChannel:
 
     # ------------------------------------------------------------ write/read
     def write(self, obj: Any, timeout: Optional[float] = None) -> None:
-        data = _dumps(obj)
-        need = 4 + len(data)
+        # message layout: [u32 ln][u32 nbuf][u64 size]*nbuf[payload][bufs]
+        # — large buffers (numpy data) are written straight from their
+        # source memory into the ring, never concatenated into one blob
+        payload, bufs = _dumps_oob(obj)
+        head = bytearray(4 + 8 * len(bufs))
+        _U32.pack_into(head, 0, len(bufs))
+        for i, b in enumerate(bufs):
+            _U64.pack_into(head, 4 + 8 * i, b.nbytes)
+        ln = len(head) + len(payload) + sum(b.nbytes for b in bufs)
+        need = 4 + ln
         # A wrapped write consumes the contiguous tail AND the message, so a
         # message over half the ring may need contig+need > capacity at an
         # unlucky offset — space that can never free up. Capping at half the
         # ring keeps every admitted message writable at every offset.
         if need > self.capacity // 2:
             raise ValueError(
-                f"message of {len(data)} bytes exceeds the channel's max "
+                f"message of {ln} bytes exceeds the channel's max "
                 f"message size ({self.capacity // 2 - 4} bytes = half its "
                 "ring); compile with a larger buffer_size_bytes"
             )
@@ -166,14 +205,30 @@ class ShmChannel:
                     _U32.pack_into(self._mm, _HDR + off, _SKIP)
                 wpos += contig
                 off = 0
-            _U32.pack_into(self._mm, _HDR + off, len(data))
-            self._mm[_HDR + off + 4:_HDR + off + 4 + len(data)] = data
+            _U32.pack_into(self._mm, _HDR + off, ln)
+            p = _HDR + off + 4
+            self._mm[p:p + len(head)] = head
+            p += len(head)
+            self._mm[p:p + len(payload)] = payload
+            p += len(payload)
+            for b in bufs:
+                self._mm[p:p + b.nbytes] = b
+                p += b.nbytes
             # publish: payload is in place before the positions move
             _U64.pack_into(self._mm, _OFF_WPOS, wpos + need)
             _U64.pack_into(self._mm, _OFF_WSEQ, self._u64(_OFF_WSEQ) + 1)
             return
 
+    def _release_slot(self) -> None:
+        """Apply a deferred read-slot release (zero-copy reads): the
+        previous message's bytes — and every view handed out over them —
+        are reclaimable only once the NEXT read begins."""
+        if self._held_rpos is not None:
+            _U64.pack_into(self._mm, _OFF_RPOS, self._held_rpos)
+            self._held_rpos = None
+
     def read(self, timeout: Optional[float] = None) -> Any:
+        self._release_slot()
         deadline = None if timeout is None else time.monotonic() + timeout
         cap = self.capacity
         backoff = _Backoff()
@@ -195,10 +250,39 @@ class ShmChannel:
             if ln == _SKIP:
                 _U64.pack_into(self._mm, _OFF_RPOS, rpos + contig)
                 continue
-            data = bytes(self._mm[_HDR + off + 4:_HDR + off + 4 + ln])
-            _U64.pack_into(self._mm, _OFF_RPOS, rpos + 4 + ln)
+            mv = memoryview(self._mm)
+            base = _HDR + off + 4
+            nbuf = _U32.unpack_from(mv, base)[0]
+            p = base + 4
+            sizes = []
+            for _ in range(nbuf):
+                sizes.append(_U64.unpack_from(mv, p)[0])
+                p += 8
+            plen = ln - 4 - 8 * nbuf - sum(sizes)
+            payload = mv[p:p + plen]
+            p += plen
+            if self.zero_copy_reads and nbuf:
+                # hand out READ-ONLY views over the mmap; defer the slot
+                # release to the next read so the views stay valid until
+                # the next message is drained from this channel
+                buffers = []
+                for s in sizes:
+                    buffers.append(mv[p:p + s].toreadonly())
+                    p += s
+                obj = pickle.loads(payload, buffers=buffers)
+                self._held_rpos = rpos + 4 + ln
+            else:
+                buffers = []
+                for s in sizes:
+                    # bytearray, not bytes: a copied-out numpy array must
+                    # stay writable (readers mutate results in place)
+                    buffers.append(bytearray(mv[p:p + s]))
+                    p += s
+                obj = pickle.loads(bytes(payload), buffers=buffers)
+                _U64.pack_into(self._mm, _OFF_RPOS, rpos + 4 + ln)
             _U64.pack_into(self._mm, _OFF_RSEQ, self._u64(_OFF_RSEQ) + 1)
-            return pickle.loads(data)
+            del mv
+            return obj
 
     # ------------------------------------------------------------- lifecycle
     def close(self) -> None:
@@ -226,6 +310,8 @@ class IntraProcessChannel:
 
     def __init__(self, max_msgs: int = 16):
         self.max_msgs = max_msgs
+        self.zero_copy_reads = False  # parity attr: in-process messages
+        # already pass by reference, there is nothing to copy out
         self._q: deque = deque()
         self._cond = threading.Condition()
         self._closed = False

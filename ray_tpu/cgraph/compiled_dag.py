@@ -480,6 +480,13 @@ class CompiledDAG:
         chans = [self._make_channel(s) for s in range(self._num_slots)]
         self._input_channels = [(acc, chans[s]) for acc, s in self._input_slots]
         self._output_channels = [chans[s] for s in self._output_slots]
+        if _config.cgraph_zero_copy_reads:
+            # driver-side result reads return READ-ONLY numpy views over
+            # the shm ring for large array payloads instead of copying out.
+            # View-lifetime rule: a result's views are valid until the next
+            # execute() drains through the same output channel.
+            for ch in self._output_channels:
+                ch.zero_copy_reads = True
         for loop in self._loops:
             loop.in_channels = [chans[s] for s in loop.in_slots]
             loop.out_channels = [chans[s] for s in loop.out_slots]

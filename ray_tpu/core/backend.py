@@ -54,6 +54,11 @@ class Backend(abc.ABC):
     def put(self, value: Any) -> ObjectRef:
         ...
 
+    def put_batch(self, values: List[Any]) -> List[ObjectRef]:
+        """Batched put (ray_tpu.put_many): backends override to amortize
+        per-op bookkeeping; the default is a plain loop."""
+        return [self.put(v) for v in values]
+
     @abc.abstractmethod
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
         ...

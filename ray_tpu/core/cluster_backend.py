@@ -274,6 +274,9 @@ class ClusterBackend(Backend):
     def put(self, value):
         return self.core.put(value)
 
+    def put_batch(self, values):
+        return self.core.put_batch(values)
+
     def get(self, refs, timeout):
         # nested get inside a task (worker mode): advise the raylet so our
         # lease's CPU frees while we block (see worker_main.get_blocking)

@@ -42,6 +42,24 @@ class Config:
     # returns to its raylet after this long without a task
     worker_lease_idle_ttl_ms: int = 500
 
+    # pipelined task submission (reference: max_tasks_in_flight_per_worker in
+    # the direct task submitter, default 10): up to this many submissions
+    # share one leased worker concurrently, overlapping the wire round trip
+    # of task N+1 with the worker-side execution of task N. Execution stays
+    # one-at-a-time via the worker's run slot; a task blocked in get() (or a
+    # stream credit wait) hands its slot to the next queued task — the
+    # in-process analog of the raylet's blocked-worker resource release — so
+    # tasks-that-get-tasks make progress under pipelining. Set 1 to disable
+    # sharing (tasks that block OUTSIDE get(), e.g. on out-of-band rendezvous,
+    # can still stall a queued peer).
+    worker_max_tasks_in_flight: int = 10
+    # bounded commitment for pipelined pushes: a pushed task that cannot
+    # START executing within this window bounces back ({"requeue": True})
+    # and the owner resubmits it to another worker (poor-man's work
+    # stealing — keeps a task queued behind a long/blocking peer from
+    # being stuck there forever)
+    worker_requeue_after_ms: int = 200
+
     # --- object store -------------------------------------------------------
     object_store_memory_mb: int = 2048
     # objects smaller than this are returned in-band to the owner's memory
@@ -49,6 +67,27 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     object_spilling_dir: str = ""
     object_store_full_delay_ms: int = 100
+
+    # --- rpc wire path (frame coalescing / zero-copy, core/rpc.py) ----------
+    # outbox flushes once per loop tick; past this many buffered bytes it
+    # flushes immediately instead of waiting for the tick (latency bound)
+    rpc_max_coalesce_bytes: int = 256 * 1024
+    # extra gather window before a scheduled flush (0 = next loop tick);
+    # raising it trades per-frame latency for bigger gather-writes
+    rpc_coalesce_delay_ms: float = 0.0
+    # backpressure: _send blocks once this many un-flushed bytes are queued
+    # on one connection (bounds memory under a slow/stalled peer)
+    rpc_max_outstanding_bytes: int = 64 * 1024 * 1024
+    # buffers at least this large ride the frame's out-of-band segment
+    # table (written from their source buffer, mapped zero-copy on receive)
+    rpc_oob_threshold_bytes: int = 64 * 1024
+    # owner-side metadata batches (object locations, ref-count releases,
+    # shm frees) flush after at most this long off the submit path
+    rpc_batch_flush_ms: float = 2.0
+    # compiled-graph result reads return read-only numpy views over the
+    # shm ring for large arrays (valid until the next execute() on that
+    # channel); set False to always copy out
+    cgraph_zero_copy_reads: bool = True
 
     # --- timeouts / health --------------------------------------------------
     health_check_period_ms: int = 1_000

@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import itertools
 import logging
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -52,18 +54,29 @@ class _MemoryStore:
             ev = self._events.setdefault(oid, asyncio.Event())
         return ev
 
-    def put_value(self, oid: ObjectID, data: bytes):
+    def _wake(self, oid: ObjectID) -> None:
+        # Wake ONLY when a waiter already created the event: the common
+        # ray.put() has no waiter, and waking the io loop per put (one
+        # call_soon_threadsafe syscall + a GIL bounce each) capped small
+        # puts at ~800 ops/s in the microbenchmark. Writers store the
+        # object BEFORE calling _wake, and wait_for re-checks the store
+        # after creating its event, so the no-event fast path can't strand
+        # a waiter (GIL-ordered dict operations).
+        ev = self._events.get(oid)
+        if ev is None:
+            return
+        if threading.current_thread().name != "ray-tpu-io":
+            self._loop.call_soon_threadsafe(ev.set)
+        else:
+            ev.set()
+
+    def put_value(self, oid: ObjectID, data):
         self._objects[oid] = ("val", data)
-        self._loop.call_soon_threadsafe(self._event(oid).set) if (
-            threading.current_thread().name != "ray-tpu-io"
-        ) else self._event(oid).set()
+        self._wake(oid)
 
     def put_error(self, oid: ObjectID, error: BaseException):
         self._objects[oid] = ("err", error)
-        if threading.current_thread().name != "ray-tpu-io":
-            self._loop.call_soon_threadsafe(self._event(oid).set)
-        else:
-            self._event(oid).set()
+        self._wake(oid)
 
     def contains(self, oid: ObjectID) -> bool:
         return oid in self._objects
@@ -73,10 +86,14 @@ class _MemoryStore:
 
     async def wait_for(self, oid: ObjectID, timeout: Optional[float]):
         if oid not in self._objects:
-            try:
-                await asyncio.wait_for(self._event(oid).wait(), timeout)
-            except asyncio.TimeoutError:
-                raise exc.GetTimeoutError(f"object {oid.hex()[:16]} not ready")
+            ev = self._event(oid)
+            if oid not in self._objects:  # re-check: no-event-yet put race
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout)
+                except asyncio.TimeoutError:
+                    raise exc.GetTimeoutError(
+                        f"object {oid.hex()[:16]} not ready"
+                    )
         return self._objects[oid]
 
     def delete(self, oid: ObjectID):
@@ -86,7 +103,17 @@ class _MemoryStore:
 
 @dataclass
 class _LeaseEntry:
-    """One cached worker lease (scheduling-key lease reuse)."""
+    """One cached worker lease (scheduling-key lease reuse).
+
+    A lease admits up to ``max_tasks_in_flight_per_worker`` concurrent
+    submissions (the reference's pipelined submission: the wire round trip
+    of task N+1 overlaps the worker-side execution of task N — without it,
+    in-flight concurrency is capped at the number of leases, and a
+    50-in-flight burst on a 4-worker box degenerates to 4-way parallelism).
+    ``inflight`` counts submissions between acquire and release; ``pooled``
+    mirrors membership in pool.idle (single source of truth for the list);
+    ``dropped`` makes concurrent failure paths return the lease only once.
+    """
 
     raylet: Any
     raylet_addr: str
@@ -94,6 +121,13 @@ class _LeaseEntry:
     worker_addr: str
     conn: Any
     last_used: float = 0.0
+    inflight: int = 0
+    pooled: bool = False
+    # a requeue bounce sets this: don't pipeline MORE tasks onto this
+    # worker (its current task is long/blocking) until the window passes;
+    # taking it at inflight == 0 is always fine
+    defer_pipeline_until: float = 0.0
+    dropped: bool = False
 
 
 class _LeasePool:
@@ -102,6 +136,9 @@ class _LeasePool:
     def __init__(self):
         self.idle: List[_LeaseEntry] = []
         self.pending = 0  # unresolved lease REQUESTS only (rate-limit gate)
+        self.backlog = 0  # submitters currently inside _acquire_lease
+        self.batch_inflight = False  # one opportunistic batch request at a time
+        self.last_kick = 0.0  # last backlog-sized batch request (cooldown)
         self.error: Optional[BaseException] = None  # latest failed request
         from collections import deque
 
@@ -123,13 +160,15 @@ class _LeasePool:
             if not fut.done():
                 fut.set_result(None)
 
-    async def wait(self, timeout: float):
+    async def wait(self, timeout: float) -> bool:
+        """Park until wake()/wake_all() or timeout. True = woken."""
         fut = asyncio.get_running_loop().create_future()
         self._waiters.append(fut)
         try:
             await asyncio.wait_for(fut, timeout)
+            return True
         except asyncio.TimeoutError:
-            pass
+            return False
 
 
 class CoreWorker:
@@ -161,6 +200,7 @@ class CoreWorker:
         self._owned: Dict[bytes, dict] = {}
         self._task_arg_pins: Dict[TaskID, List[bytes]] = {}
         self._return_oid_task: Dict[bytes, TaskID] = {}
+        self._task_live_returns: Dict[TaskID, int] = {}  # unfreed returns/task
         self._reported_borrows: set = set()           # borrower side
         self._reconstructing: Dict[bytes, asyncio.Event] = {}  # by task_id
         self._reconstruct_attempts: Dict[bytes, int] = {}      # by task_id
@@ -177,6 +217,10 @@ class CoreWorker:
         self._fn_cache: Dict[bytes, Any] = {}
         self._registered_fns: set = set()
         self._registered_blobs: Dict[bytes, bytes] = {}
+        # callable identity → fn_id: skips re-cloudpickling the same function
+        # on every submit (~0.2 ms/task — the reference exports a function
+        # descriptor once, too). Weak keys so we never pin user callables.
+        self._fn_id_by_callable = weakref.WeakKeyDictionary()
         self._packed_envs: Dict[str, dict] = {}
         self._actor_addr_cache: Dict[bytes, str] = {}
         self._actor_queues: Dict[bytes, "_ActorSubmitState"] = {}
@@ -186,6 +230,15 @@ class CoreWorker:
         self._actor_conns: Dict[str, rpc.Connection] = {}
         self._worker_conns: Dict[str, rpc.Connection] = {}
         self._raylet_conns: Dict[str, rpc.Connection] = {}
+        # owner-side metadata batching (dispatch-plane overhaul): object
+        # location records, shm frees and borrow releases queue here and
+        # flush in ONE rpc per (kind, target) after rpc_batch_flush_ms,
+        # keeping the submit/free hot paths to pure list appends
+        self._meta_batches: Dict[tuple, list] = {}
+        self._meta_handle = None
+        self._meta_tasks: set = set()
+        self._bg_tasks: set = set()  # strong refs: see _hold_bg
+        self._lease_req_seq = itertools.count(1)
         self._conn_locks: Dict[tuple, asyncio.Lock] = {}
         self.server: Optional[rpc.RpcServer] = None
         self.gcs: Optional[rpc.Connection] = None
@@ -225,10 +278,11 @@ class CoreWorker:
         if self.mode == "driver":
             await self.gcs.call("register_driver")
             await self._subscribe_logs()
-        asyncio.ensure_future(self._flush_task_events_loop())
-        asyncio.ensure_future(self._metrics_flush_loop())
-        asyncio.ensure_future(self._gcs_watchdog())
-        asyncio.ensure_future(self._lease_reaper_loop())
+        for loop_coro in (
+            self._flush_task_events_loop(), self._metrics_flush_loop(),
+            self._gcs_watchdog(), self._lease_reaper_loop(),
+        ):
+            self._hold_bg(asyncio.ensure_future(loop_coro))
 
     async def _subscribe_logs(self):
         """Driver side of the log plane (reference: worker.print_logs over
@@ -348,6 +402,16 @@ class CoreWorker:
         self.io.stop()
 
     async def _shutdown_async(self):
+        # drop queued metadata batches and let in-flight flushes settle —
+        # a flush left pending here dies noisily when the loop closes
+        if self._meta_handle is not None:
+            self._meta_handle.cancel()
+            self._meta_handle = None
+        self._meta_batches.clear()
+        if self._meta_tasks:
+            for t in self._meta_tasks:
+                t.cancel()
+            await asyncio.gather(*self._meta_tasks, return_exceptions=True)
         for conn in (
             list(self._worker_conns.values())
             + list(self._actor_conns.values())
@@ -377,6 +441,12 @@ class CoreWorker:
             if kind == "err":
                 return {"error": cloudpickle.dumps(payload)}
             if payload is not None:  # None = marker: value lives in shm
+                # large/zero-copy-stored values ride the response frame's
+                # out-of-band segment table (memoryviews are not picklable
+                # in-band anyway)
+                if isinstance(payload, memoryview) or (
+                        len(payload) >= _config.rpc_oob_threshold_bytes):
+                    return {"inline": rpc.Oob(payload)}
                 return {"inline": payload}
         loc = self.locations.get(oid)
         if loc is not None:
@@ -455,7 +525,7 @@ class CoreWorker:
         oid = ObjectID.for_task_return(TaskID(key), index)
         self._own(oid)
         if kind == "inline":
-            self.memory_store.put_value(oid, payload)
+            self.memory_store.put_value(oid, rpc.unwrap_oob(payload))
         elif kind == "location":
             self.locations[oid] = payload
             self.memory_store.put_value(oid, None)  # shm-location marker
@@ -477,8 +547,8 @@ class CoreWorker:
     # loops don't flood the bounded event buffer.
     _PROFILE_MIN_DUR_S = 0.001
 
-    def put(self, value: Any) -> ObjectRef:
-        t0 = time.perf_counter()
+    def _put_one(self, value: Any) -> Tuple[ObjectRef, int]:
+        """Shared body of put/put_batch: allocate, serialize, own, store."""
         oid = ObjectID.for_put(self.worker_id)
         data = serialization.serialize(value).to_bytes()
         ref = ObjectRef(oid, owner_addr=self.address)
@@ -487,14 +557,40 @@ class CoreWorker:
             self.memory_store.put_value(oid, data)
         else:
             self._put_shm(oid, data)
+        return ref, len(data)
+
+    def put(self, value: Any) -> ObjectRef:
+        t0 = time.perf_counter()
+        ref, nbytes = self._put_one(value)
         dur = time.perf_counter() - t0
         if dur >= self._PROFILE_MIN_DUR_S and self.events.enabled():
             self.events.record_profile(
                 "core.put", dur=dur, component="core",
                 node_id=self.node_id, worker=self.address,
-                args={"nbytes": len(data)},
+                args={"nbytes": nbytes},
             )
         return ref
+
+    def put_batch(self, values: Sequence[Any]) -> List[ObjectRef]:
+        """Batched ray.put: one pass, one profile span, shm location
+        records coalesced into a single object_added_batch flush (the
+        dispatch-plane metadata batching). Per-value work is already
+        loop-wake-free for small objects (see _MemoryStore._wake)."""
+        t0 = time.perf_counter()
+        refs = []
+        total = 0
+        for value in values:
+            ref, nbytes = self._put_one(value)
+            total += nbytes
+            refs.append(ref)
+        dur = time.perf_counter() - t0
+        if dur >= self._PROFILE_MIN_DUR_S and self.events.enabled():
+            self.events.record_profile(
+                "core.put_batch", dur=dur, component="core",
+                node_id=self.node_id, worker=self.address,
+                args={"num": len(refs), "nbytes": total},
+            )
+        return refs
 
     def _put_shm(self, oid: ObjectID, data: bytes):
         self.shm.put_bytes(oid, data)
@@ -505,13 +601,73 @@ class CoreWorker:
             "nbytes": len(data),
         }
         if self.raylet:
-            self.io.spawn(self._notify_object_added(oid, len(data)))
+            self._notify_object_added(oid, len(data))
 
-    async def _notify_object_added(self, oid, nbytes):
+    # --------------------------------------------------- metadata batching
+    # Location records (object_added), shm frees and borrow releases are
+    # bookkeeping, not results: they leave the submit path as queued items
+    # and flush as one batched rpc per (kind, target) every
+    # rpc_batch_flush_ms (parity: the reference batches location updates
+    # and ref-count flushes off CoreWorker hot paths too).
+
+    def _hold_bg(self, t: "asyncio.Task") -> "asyncio.Task":
+        """Strong ref until done: a bare ensure_future result is GC-able
+        mid-flight; a collected prefetch would leak pool.pending and pin
+        batch_inflight True, gating that scheduling key's lease kicks
+        forever."""
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
+
+    def _notify_object_added(self, oid, nbytes) -> None:
+        """Thread-safe: queue one location record for the local raylet."""
+        self.io.call_batched(
+            self._queue_meta, "object_added", None, (oid.hex(), nbytes)
+        )
+
+    def _queue_meta(self, kind: str, target: Optional[str], item) -> None:
+        """io-loop only. Queue one metadata record for the next batch flush."""
+        self._meta_batches.setdefault((kind, target), []).append(item)
+        if self._meta_handle is None:
+            self._meta_handle = self.io.loop.call_later(
+                max(0.0, _config.rpc_batch_flush_ms) / 1000.0,
+                self._flush_meta,
+            )
+
+    def _flush_meta(self) -> None:
+        self._meta_handle = None
+        batches, self._meta_batches = self._meta_batches, {}
+        for (kind, target), items in batches.items():
+            # strong ref until done: a bare ensure_future result is GC-able
+            # mid-flight (same footgun Connection._spawn guards against)
+            t = asyncio.ensure_future(self._send_meta(kind, target, items))
+            self._meta_tasks.add(t)
+            t.add_done_callback(self._meta_tasks.discard)
+
+    async def _send_meta(self, kind: str, target: Optional[str], items) -> None:
         try:
-            await self.raylet.call("object_added", oid_hex=oid.hex(), nbytes=nbytes)
+            if kind == "object_added":
+                raylet = self.raylet
+                if raylet is not None and not raylet.closed:
+                    await raylet.notify_batched(
+                        "object_added_batch", entries=items
+                    )
+            elif kind == "free":
+                conn = await self._conn_to(target, kind="raylet")
+                if conn is not None:
+                    await conn.call_batched(
+                        "free_objects", oids_hex=items, timeout=30
+                    )
+            elif kind == "release_borrow":
+                conn = await self._conn_to(target, kind="worker")
+                if conn is not None:
+                    await conn.call_batched(
+                        "release_borrows", entries=items, timeout=30
+                    )
         except (rpc.RpcError, rpc.ConnectionLost):
             pass
+        except Exception:  # noqa: BLE001 - bookkeeping must never kill io
+            logger.exception("metadata batch flush failed (%s)", kind)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not self.events.enabled():
@@ -587,19 +743,18 @@ class CoreWorker:
         if oid in self.locations:
             data = await self._read_location(oid, self.locations[oid])
             return await self._maybe_reconstruct(ref, data, deadline)
-        # 2) local shm store (results produced on this node)
+        # 2) own memory store (inline values + pending task results). Checked
+        #    BEFORE the shm probe: every owned object lands in the memory
+        #    store or in `locations` (step 1), and a shm miss probe is an
+        #    open(2) raising FileNotFoundError — ~46us per get in sandboxed
+        #    kernels, paid once per task result before this reorder.
+        if self.memory_store.contains(oid) or ref.owner_addr in (None, self.address):
+            return await self._fetch_from_memory_store(ref, oid, timeout, deadline)
+        # 3) local shm store (results produced on this node by other workers,
+        #    read by a borrower without an owner round trip)
         buf = self.shm.get(oid)
         if buf is not None:
             return buf.buffer
-        # 3) own memory store (inline values + pending task results)
-        if self.memory_store.contains(oid) or ref.owner_addr in (None, self.address):
-            kind, payload = await self.memory_store.wait_for(oid, timeout)
-            if kind == "err":
-                return payload
-            if payload is None:  # marker: result went to shm
-                data = await self._read_location(oid, self.locations.get(oid))
-                return await self._maybe_reconstruct(ref, data, deadline)
-            return payload
         # 4) ask the owner (borrower path)
         lost_notifies = 0
         while True:
@@ -609,7 +764,7 @@ class CoreWorker:
             if "error" in info:
                 return cloudpickle.loads(info["error"])
             if "inline" in info:
-                return info["inline"]
+                return rpc.unwrap_oob(info["inline"])
             if "location" in info:
                 data = await self._read_location(oid, info["location"])
                 if not isinstance(data, exc.ObjectLostError):
@@ -632,6 +787,15 @@ class CoreWorker:
             if deadline is not None and time.monotonic() > deadline:
                 raise exc.GetTimeoutError(f"get timed out on {oid.hex()[:16]}")
             await asyncio.sleep(0.01)
+
+    async def _fetch_from_memory_store(self, ref, oid, timeout, deadline):
+        kind, payload = await self.memory_store.wait_for(oid, timeout)
+        if kind == "err":
+            return payload
+        if payload is None:  # marker: result went to shm
+            data = await self._read_location(oid, self.locations.get(oid))
+            return await self._maybe_reconstruct(ref, data, deadline)
+        return payload
 
     async def _maybe_reconstruct(self, ref: ObjectRef, data, deadline):
         """Owner-side: a location read failed → resubmit the creating task
@@ -685,7 +849,7 @@ class CoreWorker:
             try:
                 data = await conn.call("fetch_object", oid_hex=oid.hex(), timeout=120)
                 if data is not None:
-                    return data
+                    return rpc.unwrap_oob(data)
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
         return exc.ObjectLostError(oid, "object unavailable on all nodes")
@@ -778,6 +942,12 @@ class CoreWorker:
 
     # ------------------------------------------------------- task submission
     def register_function(self, fn) -> bytes:
+        try:
+            cached = self._fn_id_by_callable.get(fn)
+        except TypeError:  # unhashable/unweakrefable callable
+            cached = None
+        if cached is not None:
+            return cached
         blob = _pickle_callable(fn)
         fn_id = ts.function_id(blob)
         if fn_id not in self._registered_fns:
@@ -789,6 +959,10 @@ class CoreWorker:
             self._registered_fns.add(fn_id)
             self._registered_blobs[fn_id] = blob
             self._fn_cache[fn_id] = fn
+        try:
+            self._fn_id_by_callable[fn] = fn_id
+        except TypeError:
+            pass
         return fn_id
 
     async def _gcs_call_retrying(self, method, attempts: int = 10, **kw):
@@ -881,12 +1055,14 @@ class CoreWorker:
             from ray_tpu.streaming import ObjectRefGenerator
 
             state = self._make_stream(task_id, spec.backpressure, spec.name)
-            self.io.spawn(self._submit_stream_and_track(spec, state))
+            self.io.call_batched(self._submit_stream_and_track(spec, state))
             return ObjectRefGenerator(state)
         refs = spec.return_refs()
         for r in refs:
             self._own(r.id, task_id)
-        self.io.spawn(self._submit_and_track(spec, refs))
+        # batched wake: a 50-in-flight submission burst from the driver
+        # thread costs one self-pipe write, not 50
+        self.io.call_batched(self._submit_and_track(spec, refs))
         return refs
 
     async def _submit_stream_and_track(self, spec: ts.TaskSpec, state):
@@ -1008,23 +1184,53 @@ class CoreWorker:
     async def _submit_once(self, spec: ts.TaskSpec) -> dict:
         key = self._sched_key(spec)
         pool = self._lease_pool(key)
-        entry = await self._acquire_lease(pool, spec)
-        self._record_task_event(spec, "DISPATCHED", worker=entry.worker_addr)
-        try:
-            blob = cloudpickle.dumps(spec)
-            result = await entry.conn.call(
-                "push_task", spec_blob=blob, timeout=None
+        while True:
+            pool.backlog += 1
+            try:
+                entry = await self._acquire_lease(pool, spec)
+            finally:
+                pool.backlog -= 1
+            self._record_task_event(
+                spec, "DISPATCHED", worker=entry.worker_addr
             )
-        except rpc.ConnectionLost as e:
-            await self._drop_lease(pool, entry)
-            raise exc.WorkerCrashedError(str(e)) from e
-        except BaseException:
-            await self._drop_lease(pool, entry)
-            raise
+            try:
+                # batched push: specs headed to the same worker connection in
+                # the same loop tick share one multi-spec BATCH frame; the
+                # spec rides the frame pickler (protocol-5), so large inline
+                # args (Oob-wrapped in encode_args) go out-of-band, zero-copy
+                result = await entry.conn.call_batched(
+                    "push_task", spec=spec, timeout=None
+                )
+            except rpc.ConnectionLost as e:
+                await self._drop_lease(pool, entry)
+                raise exc.WorkerCrashedError(str(e)) from e
+            except BaseException:
+                await self._drop_lease(pool, entry)
+                raise
+            finally:
+                self._release_lease_slot(pool, entry)
+            if isinstance(result, dict) and result.get("requeue"):
+                # the worker couldn't START it within worker_requeue_after_ms
+                # (long/blocking task holds the run slot): resubmit to
+                # another worker and stop pipelining onto this one meanwhile
+                entry.defer_pipeline_until = time.monotonic() + 1.0
+                continue
+            return result
+
+    def _release_lease_slot(self, pool: "_LeasePool", entry: "_LeaseEntry"):
+        """One pipelined submission settled: free its slot and re-pool the
+        entry if the full window had taken it out of pool.idle."""
+        entry.inflight -= 1
         entry.last_used = time.monotonic()
-        pool.idle.append(entry)
+        if entry.conn is not None and entry.conn.closed:
+            entry.dropped = True  # conn died: never hand this entry out again
+        self._pool_entry(pool, entry)
+
+    def _pool_entry(self, pool: "_LeasePool", entry: "_LeaseEntry") -> None:
+        if not entry.dropped and not entry.pooled:
+            entry.pooled = True
+            pool.idle.append(entry)
         pool.wake()
-        return result
 
     async def _acquire_lease(self, pool: "_LeasePool", spec) -> "_LeaseEntry":
         """Take an idle cached lease, or request a fresh one.
@@ -1038,52 +1244,129 @@ class CoreWorker:
         SHARED pool before being re-popped, so a grant arriving while a
         cached entry freed up serves whichever waiter is first.
         """
-        import uuid as _uuid
-
+        depth = max(1, _config.worker_max_tasks_in_flight)
         while True:
             while pool.idle:
-                entry = pool.idle.pop()
-                if entry.conn is not None and not entry.conn.closed:
-                    return entry
-                await self._drop_lease(pool, entry)
+                # breadth first: the least-loaded leased worker takes the
+                # next task (pipelining fills a second slot on a busy worker
+                # only once every worker has one); pool.idle is O(#workers).
+                # Entries a requeue bounce marked defer_pipeline_until are
+                # skipped for PIPELINED placement (their running task is
+                # long/blocking) but stay takeable at inflight == 0.
+                now = time.monotonic()
+                usable = [
+                    e for e in pool.idle
+                    if e.inflight == 0 or now >= e.defer_pipeline_until
+                ]
+                if not usable:
+                    break  # only deferred busy workers: get a fresh lease
+                entry = min(usable, key=lambda e: e.inflight)
+                if entry.conn is None or entry.conn.closed:
+                    pool.idle.remove(entry)
+                    entry.pooled = False
+                    await self._drop_lease(pool, entry)
+                    continue
+                if entry.inflight > 0:
+                    # Pipelining onto a busy worker: fine for overlapping
+                    # the wire, but it must not CAP parallelism — keep one
+                    # lease request in flight so grants grow the pool to
+                    # what the cluster can actually run (the reference
+                    # requests workers for backlog while it pipelines too).
+                    self._kick_backlog_lease(pool, spec)
+                entry.inflight += 1
+                if entry.inflight >= depth:
+                    # window full: out of the pool until a slot frees
+                    pool.idle.remove(entry)
+                    entry.pooled = False
+                return entry
             # Rate-limit UNRESOLVED requests only (matching the reference's
             # lease-request limiter): granted leases are unbounded, so
             # long-running same-shape tasks keep full cluster parallelism.
             if pool.pending >= _config.max_pending_lease_requests_per_scheduling_key:
                 await pool.wait(timeout=0.5)
                 continue
+            # scheduling key with backlog: piggyback ONE batched lease
+            # request for the other waiting submitters (count bounded by
+            # the pending budget) so a 50-in-flight burst costs a handful
+            # of request_lease RPCs instead of 50 sequential round trips
+            budget = _config.max_pending_lease_requests_per_scheduling_key
+            extra = min(pool.backlog - 1 - pool.pending, budget - pool.pending - 1)
+            if extra > 0 and not pool.batch_inflight:
+                pool.batch_inflight = True
+                pool.pending += extra
+                self._hold_bg(asyncio.ensure_future(
+                    self._prefetch_leases(pool, spec, extra)
+                ))
+            if pool.pending > 0:
+                # A request is already in flight for this key. Racing one
+                # per waiter costs a request+cancel RPC pair at the raylet
+                # on nearly every task once the cluster is saturated
+                # (measured 0.92 frames/task at 50 in flight) — park
+                # instead; a grant or a returned cached lease wakes us.
+                # A timeout (lost requester, e.g. cancelled mid-await)
+                # falls through to firing our own request.
+                if await pool.wait(timeout=0.5):
+                    continue
             # race a fresh lease request against a cached entry freeing up;
             # the loser is cleaned up (queued request → cancel RPC; grant
             # that slips through anyway → pooled for the next waiter)
             pool.pending += 1
-            req_id = _uuid.uuid4().hex
+            req_id = f"{self.worker_id.hex()[:12]}-{next(self._lease_req_seq)}"
             holder: Dict[str, Any] = {}
             req = asyncio.ensure_future(
                 self._request_new_lease(spec, req_id=req_id, holder=holder)
             )
-            waiter = asyncio.get_running_loop().create_future()
-            pool._waiters.append(waiter)
-            await asyncio.wait(
-                {req, waiter}, return_when=asyncio.FIRST_COMPLETED
-            )
-            if req.done():
-                pool.pending -= 1
-                pool.wake()  # a pending slot freed: let a gated waiter retry
+            retired = False
+            while not req.done():
+                waiter = asyncio.get_running_loop().create_future()
+                pool._waiters.append(waiter)
+                try:
+                    await asyncio.wait(
+                        {req, waiter}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                except BaseException:
+                    # cancelled mid-await: leaving pool.pending incremented
+                    # forever would park every later submitter on the timeout
+                    # path — hand the request to the background settler
+                    # (cancel at the raylet, decrement pending, pool a raced
+                    # grant)
+                    self._hold_bg(asyncio.ensure_future(
+                        self._settle_request(pool, req, req_id, holder)
+                    ))
+                    if not waiter.done():
+                        waiter.cancel()
+                    raise
                 if not waiter.done():
                     waiter.cancel()
-                try:
-                    entry = req.result()
-                except BaseException:
-                    pool.wake()
-                    raise
-                if entry is None:  # canceled under us (shouldn't happen here)
-                    continue
-                pool.idle.append(entry)
+                if req.done():
+                    break
+                if pool.idle:
+                    # a cached entry really freed: take it, retire our
+                    # request
+                    self._hold_bg(asyncio.ensure_future(
+                        self._settle_request(pool, req, req_id, holder)
+                    ))
+                    retired = True
+                    break
+                # spurious wake (e.g. an all-backlogged batch request freeing
+                # its pending budget via wake_all): nothing to pop, and our
+                # standing request is the only demand signal the raylet — and
+                # the autoscaler behind it — can see. Re-arm and keep waiting;
+                # retiring here livelocked CPU-starved clusters (the canceled
+                # request left zero queued demand, so nothing ever scaled).
+            if retired:
+                continue
+            pool.pending -= 1
+            pool.wake()  # a pending slot freed: let a gated waiter retry
+            try:
+                entry = req.result()
+            except BaseException:
                 pool.wake()
-                continue  # re-pop: usually our own grant, FIFO otherwise
-            # a cached entry freed first: take it, retire our request
-            asyncio.ensure_future(self._settle_request(pool, req, req_id, holder))
-            continue
+                raise
+            if entry is None:  # canceled under us (shouldn't happen here)
+                continue
+            self._pool_entry(pool, entry)
+            continue  # re-pop: usually our own grant, FIFO otherwise
 
     async def _settle_request(self, pool: "_LeasePool", req, req_id, holder):
         """Background cleanup for a lease request whose submitter was served
@@ -1106,11 +1389,92 @@ class CoreWorker:
         if entry is None:      # canceled cleanly
             pool.wake()
             return
-        pool.idle.append(entry)
-        pool.wake()
+        self._pool_entry(pool, entry)
 
+
+    def _kick_backlog_lease(self, pool: "_LeasePool", spec) -> None:
+        """Fire-and-forget one batched lease request, sized to the key's
+        backlog, when submissions are stacking onto busy workers and nothing
+        is pending. Grants land in the shared pool (zero-inflight entries
+        every later submitter prefers); `backlogged` replies just free the
+        budget. The raylet drops non-granted batch demand (by design — see
+        handle_request_lease_batch), so the cooldown re-poll is what keeps
+        a standing demand signal at the raylet while a burst lasts: each
+        kick also lets its dispatch tick spawn one more worker."""
+        if pool.pending > 0 or pool.batch_inflight:
+            return
+        now = time.monotonic()
+        if now - pool.last_kick < 0.01:
+            return
+        pool.last_kick = now
+        budget = _config.max_pending_lease_requests_per_scheduling_key
+        count = max(1, min(pool.backlog, budget))
+        pool.batch_inflight = True
+        pool.pending += count
+        self._hold_bg(asyncio.ensure_future(self._prefetch_leases(pool, spec, count)))
+
+    async def _prefetch_leases(self, pool: "_LeasePool", spec, count: int):
+        """Opportunistic batched lease request (raylet request_lease_batch):
+        one RPC asks for `count` leases on behalf of the scheduling key's
+        backlog. Grants land in the shared idle pool and serve whichever
+        submitter pops first; non-grant replies just free the budget (the
+        authoritative single requests still drive spillback/infeasibility).
+        """
+        try:
+            raylet = await self._ensure_raylet()
+            if raylet is None or raylet.closed:
+                return
+            raylet_addr = self.raylet_address
+            try:
+                replies = await raylet.call(
+                    "request_lease_batch",
+                    resources=spec.resources,
+                    count=count,
+                    pg_id=spec.placement_group_id,
+                    bundle_index=spec.placement_group_bundle_index,
+                    timeout=None,
+                )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                return
+            for reply in replies or []:
+                if "granted" not in reply:
+                    continue
+                conn = await self._conn_to(reply["granted"], kind="worker")
+                if conn is None:
+                    try:
+                        await raylet.call(
+                            "return_lease", lease_id=reply["lease_id"],
+                            timeout=10,
+                        )
+                    except (rpc.RpcError, rpc.ConnectionLost):
+                        pass
+                    continue
+                self._pool_entry(pool, _LeaseEntry(
+                    raylet=raylet,
+                    raylet_addr=raylet_addr,
+                    lease_id=reply["lease_id"],
+                    worker_addr=reply["granted"],
+                    conn=conn,
+                    last_used=time.monotonic(),
+                ))
+        except Exception:  # noqa: BLE001 - prefetch must never fail a task
+            logger.exception("lease prefetch failed")
+        finally:
+            pool.pending -= count
+            pool.batch_inflight = False
+            pool.wake_all()
 
     async def _drop_lease(self, pool, entry: "_LeaseEntry"):
+        if entry.dropped:  # pipelined peers may all observe the same death
+            pool.wake()
+            return
+        entry.dropped = True
+        if entry.pooled:
+            entry.pooled = False
+            try:
+                pool.idle.remove(entry)
+            except ValueError:
+                pass
         pool.wake()
         try:
             await entry.raylet.call(
@@ -1199,16 +1563,31 @@ class CoreWorker:
 
     async def _lease_reaper_loop(self):
         """Return leases idle past the TTL so cached workers free their
-        resources for other scheduling keys / drivers."""
+        resources for other scheduling keys / drivers. Expired leases of
+        one raylet return in a single batched return_leases RPC."""
         ttl = _config.worker_lease_idle_ttl_ms / 1000
         while True:
             await asyncio.sleep(ttl / 2)
             now = time.monotonic()
+            expired: Dict[int, tuple] = {}
             for pool in list(self._lease_pools.values()):
                 for entry in list(pool.idle):
-                    if now - entry.last_used > ttl:
+                    if now - entry.last_used > ttl and entry.inflight == 0:
                         pool.idle.remove(entry)
-                        await self._drop_lease(pool, entry)
+                        entry.pooled = False
+                        entry.dropped = True  # a late release must not re-pool
+                        pool.wake()
+                        _, ids = expired.setdefault(
+                            id(entry.raylet), (entry.raylet, [])
+                        )
+                        ids.append(entry.lease_id)
+            for raylet, lease_ids in expired.values():
+                try:
+                    await raylet.call(
+                        "return_leases", lease_ids=lease_ids, timeout=10
+                    )
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
 
     async def _pg_node_addr(self, pg_id: bytes, bundle_index: int):
         info = await self.gcs.call("get_placement_group", pg_id=pg_id, timeout=30)
@@ -1236,7 +1615,7 @@ class CoreWorker:
             entries = [e for e in entries if e[0] not in ("streamed",)]
         for ref, (kind, payload) in zip(refs, entries):
             if kind == "inline":
-                self.memory_store.put_value(ref.id, payload)
+                self.memory_store.put_value(ref.id, rpc.unwrap_oob(payload))
             elif kind == "location":
                 self.locations[ref.id] = payload
                 # marker so local waiters wake up and read the location
@@ -1276,10 +1655,9 @@ class CoreWorker:
                 if self._is_owner(owner_addr):
                     continue
                 if not pins and refs_mod.local_ref_count(key) == 0:
-                    self.io.spawn(self._notify_owner(
-                        owner_addr, "release_borrow",
-                        oid_hex=oid_hex, addr=self.address,
-                    ))
+                    self._queue_meta(
+                        "release_borrow", owner_addr, (oid_hex, self.address)
+                    )
                     continue
                 self._reported_borrows.add(key)
                 self._granted_owner[key] = owner_addr
@@ -1339,7 +1717,15 @@ class CoreWorker:
     def _own(self, oid: ObjectID, task_id: Optional[TaskID] = None) -> None:
         self._owned.setdefault(oid.binary(), {"pending": 0, "borrowers": set()})
         if task_id is not None:
-            self._return_oid_task[oid.binary()] = task_id
+            # _own runs on user threads, the free path on the io loop: the
+            # lock (plus the per-task live-return COUNT, instead of a scan
+            # over this dict) keeps _maybe_free from iterating a dict a
+            # submitting thread is growing
+            with self._lock:
+                self._return_oid_task[oid.binary()] = task_id
+                self._task_live_returns[task_id] = (
+                    self._task_live_returns.get(task_id, 0) + 1
+                )
 
     def _pin_task_args(self, task_id: TaskID, enc_args, enc_kwargs) -> None:
         pins: List[bytes] = []
@@ -1365,9 +1751,10 @@ class CoreWorker:
         """GC callback (arbitrary thread): last local ObjectRef died."""
         try:
             if self._is_owner(owner_addr):
-                self.io.loop.call_soon_threadsafe(
-                    self._maybe_free, oid.binary()
-                )
+                # batched wake (io.call_batched): a gc sweep dropping N refs
+                # costs one self-pipe write, not N — the per-ref
+                # call_soon_threadsafe here was 75% of small-put time
+                self.io.call_batched(self._maybe_free, oid.binary())
             elif oid.binary() in self._reported_borrows:
                 if self._granting_outers.get(oid.binary()):
                     # an outer result value still pins this borrow: a later
@@ -1376,11 +1763,9 @@ class CoreWorker:
                     return
                 self._reported_borrows.discard(oid.binary())
                 self._granted_owner.pop(oid.binary(), None)
-                self.io.spawn(
-                    self._notify_owner(
-                        owner_addr, "release_borrow", oid_hex=oid.hex(),
-                        addr=self.address,
-                    )
+                self.io.call_batched(
+                    self._queue_meta, "release_borrow", owner_addr,
+                    (oid.hex(), self.address),
                 )
         except Exception:  # noqa: BLE001 - shutdown
             pass
@@ -1410,8 +1795,8 @@ class CoreWorker:
         addrs = {a for a in (
             loc.get("raylet_addr") if loc else None, self.raylet_address
         ) if a}
-        if addrs:
-            self.io.spawn(self._free_on_raylets(oid, addrs))
+        for addr in addrs:  # frees flush in per-raylet batches off this path
+            self._queue_meta("free", addr, oid.hex())
         # borrows granted through this (outer) result value: the outer no
         # longer pins them — release any with no other pin and no live ref
         for inner in self._granted_by_outer.pop(key, ()):
@@ -1426,29 +1811,25 @@ class CoreWorker:
                 self._reported_borrows.discard(inner)
                 owner = self._granted_owner.pop(inner, None)
                 if owner:
-                    self.io.spawn(
-                        self._notify_owner(
-                            owner, "release_borrow",
-                            oid_hex=ObjectID(inner).hex(), addr=self.address,
-                        )
+                    self._queue_meta(
+                        "release_borrow", owner,
+                        (ObjectID(inner).hex(), self.address),
                     )
         # lineage cleanup: once every return of a task is freed, its spec is
         # no longer needed for reconstruction
-        tid = self._return_oid_task.pop(key, None)
-        if tid is not None and not any(
-            t == tid for t in self._return_oid_task.values()
-        ):
+        with self._lock:
+            tid = self._return_oid_task.pop(key, None)
+            last = False
+            if tid is not None:
+                n = self._task_live_returns.get(tid, 0) - 1
+                if n <= 0:
+                    self._task_live_returns.pop(tid, None)
+                    last = True
+                else:
+                    self._task_live_returns[tid] = n
+        if last:
             self.submitted_specs.pop(tid, None)
             self._task_arg_pins.pop(tid, None)
-
-    async def _free_on_raylets(self, oid: ObjectID, addrs) -> None:
-        for addr in addrs:
-            conn = await self._conn_to(addr, kind="raylet")
-            if conn is not None:
-                try:
-                    await conn.call("free_objects", oids_hex=[oid.hex()], timeout=30)
-                except (rpc.RpcError, rpc.ConnectionLost):
-                    pass
 
     # owner-side borrow bookkeeping.
     # A borrower's release (its own connection) can arrive BEFORE the add
@@ -1478,6 +1859,13 @@ class CoreWorker:
             self._maybe_free(key)
         elif entry is not None:
             self._early_borrow_releases.setdefault(key, set()).add(addr)
+        return True
+
+    def handle_release_borrows(self, conn, entries):
+        """Batched release_borrow: borrowers flush their zero-crossings in
+        groups off the GC path (dispatch-plane batching)."""
+        for oid_hex, addr in entries:
+            self.handle_release_borrow(conn, oid_hex, addr)
         return True
 
     def report_new_borrows(self) -> List[tuple]:
@@ -1645,7 +2033,9 @@ class CoreWorker:
                 self.io.spawn(
                     self._actor_queue_consumer(actor_id.binary(), st)
                 )
-        self.io.loop.call_soon_threadsafe(st.queue.put_nowait, (spec, refs))
+        # batched wake, same FIFO: queue order (not wake count) carries the
+        # actor's seq ordering, so a 100-call burst costs one self-pipe write
+        self.io.call_batched(st.queue.put_nowait, (spec, refs))
         return out if out is not None else refs
 
     async def _actor_queue_consumer(self, actor_bin: bytes, st: "_ActorSubmitState"):
@@ -1689,8 +2079,8 @@ class CoreWorker:
                         st.gate.clear()
                         asyncio.ensure_future(self._recover_actor_calls(st))
                     continue
-                fut = await conn.call_start(
-                    "push_actor_task", spec_blob=cloudpickle.dumps(spec)
+                fut = await conn.call_start_batched(
+                    "push_actor_task", spec=spec
                 )
             except rpc.ConnectionLost:
                 st.inflight.pop(seq, None)
@@ -1818,10 +2208,8 @@ class CoreWorker:
                 await asyncio.sleep(_config.actor_restart_backoff_s)
                 continue
             try:
-                result = await conn.call(
-                    "push_actor_task",
-                    spec_blob=cloudpickle.dumps(spec),
-                    timeout=None,
+                result = await conn.call_batched(
+                    "push_actor_task", spec=spec, timeout=None,
                 )
                 self._store_task_result(spec, refs, result)
                 return

@@ -706,6 +706,11 @@ class GcsServer:
     def handle_get_metrics(self, conn):
         m = dict(self.metrics)
         m.update(self.task_events.stats())  # tracing drop/retention counters
+        # the GCS's own wire counters, namespaced so they don't collide with
+        # the caller's (util/state.summarize_metrics merges the driver's
+        # un-prefixed rpc_* counters on top of this reply)
+        for k, v in rpc.stats_snapshot().items():
+            m["gcs_" + k] = v
         m["num_nodes"] = len(self.nodes)
         m["num_alive_nodes"] = sum(1 for n in self.nodes.values() if n.alive)
         m["num_actors"] = len(self.actors)

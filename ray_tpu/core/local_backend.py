@@ -671,6 +671,17 @@ class LocalBackend(Backend):
         self._future_for(oid).set_result(value)
         return ObjectRef(oid)
 
+    def put_batch(self, values) -> List[ObjectRef]:
+        """Parity with CoreWorker.put_batch (ray_tpu.put_many): one sweep
+        for the whole list so tier-1 exercises the batched code shape the
+        cluster backend runs."""
+        refs = []
+        for value in values:
+            oid = ObjectID.for_put(self.worker_id)
+            self._future_for(oid).set_result(value)
+            refs.append(ObjectRef(oid))
+        return refs
+
     def create_deferred(self):
         oid = ObjectID.for_put(self.worker_id)
         ref = ObjectRef(oid)

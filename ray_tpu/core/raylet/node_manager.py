@@ -418,6 +418,94 @@ class Raylet:
             self._lease_owners.setdefault(conn, set()).add(reply["lease_id"])
         return reply
 
+    async def handle_request_lease_batch(
+        self, conn, resources, count, pg_id=None, bundle_index=-1,
+    ):
+        """Batched lease requests (dispatch-plane batching): an owner whose
+        scheduling key has backlog asks for `count` leases in ONE rpc
+        instead of `count` round trips. Replies with the per-lease result
+        dicts ({granted}/{spillback}/{infeasible}), all in one frame."""
+        count = max(1, min(int(count), 64))
+        if pg_id is not None:
+            if not any(k[0] == pg_id for k in self.bundles) or (
+                    bundle_index >= 0
+                    and (pg_id, bundle_index) not in self.bundles):
+                return [
+                    {"infeasible": True, "reason": "bundle not on this node"}
+                ] * count
+        leases = []
+        for _ in range(count):
+            leases.append(LeaseRequest(
+                lease_id=uuid.uuid4().hex,
+                demand=ResourceSet(resources),
+                future=asyncio.get_running_loop().create_future(),
+                allow_spillback=pg_id is None,
+                pg_id=pg_id,
+                bundle_index=bundle_index,
+                owner_conn=conn,
+            ))
+        self.pending_leases.extend(leases)
+        await self._dispatch()
+        # Non-blocking by design: grant whatever fits NOW, answer
+        # {backlogged: True} for the rest instead of queueing them. A
+        # gather over queued futures here held granted workers hostage
+        # inside a reply that could never complete while the cluster was
+        # saturated (the queued sub-leases only resolve when capacity
+        # frees, which cached-lease reuse prevents) — the authoritative
+        # blocking path stays the single request_lease.
+        replies = []
+        for lr in leases:
+            if lr.future.done():
+                replies.append(lr.future.result())
+            else:
+                lr.future.set_result({"backlogged": True})
+                try:
+                    self.pending_leases.remove(lr)
+                except ValueError:
+                    pass
+                replies.append({"backlogged": True})
+        for reply in replies:
+            if "granted" in reply and conn is not None:
+                self._lease_owners.setdefault(conn, set()).add(
+                    reply["lease_id"]
+                )
+        return replies
+
+    def _spawnable_demand(self) -> int:
+        """How many queued leases could hold resources CONCURRENTLY right
+        now — a greedy pack of pending demands into the available set.
+        Zero-demand leases (num_cpus=0) always count: they need a worker
+        but no resources."""
+        avail = self.available
+        n = 0
+        for lease in self.pending_leases:
+            if lease.future.done():
+                continue
+            if lease.pg_id is not None:
+                n += 1  # draws from the bundle reservation, already carved
+                continue
+            if avail.fits(lease.demand):
+                avail = avail.subtract(lease.demand)
+                n += 1
+        return n
+
+    def _fits_now(self, lease: LeaseRequest) -> bool:
+        """Non-destructive twin of _acquire_for: could this lease take
+        resources right now? (Gates worker spawning: no point adding a
+        worker for a lease whose RESOURCES are the shortage.)"""
+        if lease.pg_id is not None:
+            keys = (
+                [(lease.pg_id, lease.bundle_index)]
+                if lease.bundle_index >= 0
+                else [k for k in self.bundle_free if k[0] == lease.pg_id]
+            )
+            return any(
+                self.bundle_free.get(k) is not None
+                and self.bundle_free[k].fits(lease.demand)
+                for k in keys
+            )
+        return self.available.fits(lease.demand)
+
     def _acquire_for(self, lease: LeaseRequest) -> Optional[object]:
         return self._acquire(lease.demand, lease.pg_id, lease.bundle_index)
 
@@ -515,6 +603,13 @@ class Raylet:
             idle = self.pool.idle_workers()
             if not idle:
                 self._disp["skipped_no_worker"] += 1
+                if not self._fits_now(lease):
+                    # resources are the shortage, not workers: a spawn here
+                    # adds an idle process that can never be leased (seen as
+                    # 4 useless workers per 50-task burst on a saturated
+                    # node — pure scheduler thrash on small boxes)
+                    self._disp["skipped_no_resources"] += 1
+                    continue
                 starting = sum(
                     1 for w in self.pool.workers.values() if w.state == "STARTING"
                 )
@@ -528,8 +623,11 @@ class Raylet:
                     if w.state != DEAD and w.startup_token not in blocked_workers
                 )
                 # spawn at most one per tick, only when the pipeline of
-                # starting workers doesn't already cover the queue
-                if starting < len(self.pending_leases) and alive < self._worker_cap():
+                # starting workers doesn't already cover the demand that can
+                # actually RUN concurrently (not the raw queue length — a
+                # 50-deep backlog on 4 CPU slots can use at most 4 workers)
+                if (starting < self._spawnable_demand()
+                        and alive < self._worker_cap()):
                     self.pool.start_worker()
                 continue
             token = self._acquire_for(lease)
@@ -595,6 +693,13 @@ class Raylet:
         # microbenchmark — one dispatch round per tick)
         if self.pending_leases:
             asyncio.ensure_future(self._dispatch())
+        return True
+
+    def handle_return_leases(self, conn, lease_ids):
+        """Batched return_lease: the owner's idle-TTL reaper returns whole
+        groups of cached leases in one rpc."""
+        for lease_id in lease_ids:
+            self.handle_return_lease(conn, lease_id)
         return True
 
     # ------------------------------------------------------------- workers
@@ -722,6 +827,13 @@ class Raylet:
         self.directory.add(ObjectID.from_hex(oid_hex), nbytes)
         return True
 
+    def handle_object_added_batch(self, conn, entries):
+        """Batched location records: owners flush (oid, nbytes) pairs in
+        groups off the put/return hot path."""
+        for oid_hex, nbytes in entries:
+            self.directory.add(ObjectID.from_hex(oid_hex), nbytes)
+        return True
+
     def handle_object_stats(self, conn):
         return self.directory.stats()
 
@@ -731,7 +843,15 @@ class Raylet:
         return True
 
     async def handle_fetch_object(self, conn, oid_hex):
-        """Peer raylet (or local client) reads object bytes for transfer."""
+        """Peer raylet (or local client) reads object bytes for transfer.
+
+        The reply rides the frame's out-of-band segment table straight from
+        the sealed object's mmap — no copy into the response pickle. The
+        The ShmBuffer's mapping stays pinned until the frame is written:
+        the frame encoder puts the raw buffer view itself into the outbox
+        chunk list (Oob.keepalive additionally pins the ShmBuffer object
+        through encode).
+        """
         oid = ObjectID.from_hex(oid_hex)
         buf = self.shm.get(oid)
         if buf is None:
@@ -741,9 +861,7 @@ class Raylet:
             if buf is None:
                 return None
         self.directory.touch(oid)
-        data = bytes(buf.buffer)
-        buf.close()
-        return data
+        return rpc.Oob(buf.buffer, keepalive=buf)
 
     async def handle_pull_object(self, conn, oid_hex, source_addr,
                                  nbytes=None):
@@ -776,9 +894,11 @@ class Raylet:
             return False
         if data is None:
             return False
-        self.directory.ensure_capacity(len(data))
+        data = rpc.unwrap_oob(data)  # zero-copy view over the reply frame
+        n = data.nbytes if isinstance(data, memoryview) else len(data)
+        self.directory.ensure_capacity(n)
         self.shm.put_bytes(oid, data)
-        self.directory.add(oid, len(data))
+        self.directory.add(oid, n)
         return True
 
     async def _native_pull(self, oid, oid_hex: str, source_addr: str,

@@ -70,6 +70,28 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return len(self.payload) + sum(b.nbytes for b in self.buffers)
 
+    def __reduce_ex__(self, protocol):
+        """Wire transport (core/rpc.py v2 frames): payload and buffers
+        travel as protocol-5 ``PickleBuffer``s, so the frame encoder writes
+        them straight from their source memory (the user's numpy array, a
+        shm mapping) into the frame's out-of-band segment table and the
+        receiver maps them back as zero-copy views over the frame body —
+        no ``to_bytes`` flatten on send, no ``from_buffer`` re-parse on
+        receive. ``contained_refs`` intentionally does not cross the wire:
+        nested ObjectRefs re-register when the payload is deserialized."""
+        if protocol >= 5:
+            return (
+                _wire_serialized,
+                (
+                    pickle.PickleBuffer(self.payload),
+                    tuple(pickle.PickleBuffer(b) for b in self.buffers),
+                ),
+            )
+        return (
+            _wire_serialized,
+            (bytes(self.payload), tuple(bytes(b) for b in self.buffers)),
+        )
+
     def to_bytes(self) -> bytes:
         """Flatten to a single framed byte string (for wire transfer / shm)."""
         out = io.BytesIO()
@@ -104,6 +126,18 @@ class SerializedObject:
             buffers.append(mv[off : off + s])
             off += s
         return SerializedObject(payload, buffers, [])
+
+
+def _wire_serialized(payload, buffers) -> "SerializedObject":
+    """Rebuild a SerializedObject on the receiving side of a wire frame.
+    ``payload``/``buffers`` arrive as PickleBuffers resolved to zero-copy
+    views over the frame body (or plain bytes from a pre-v5 pickler)."""
+    return SerializedObject(
+        payload if isinstance(payload, (bytes, memoryview))
+        else memoryview(payload),
+        [b if isinstance(b, memoryview) else memoryview(b) for b in buffers],
+        [],
+    )
 
 
 def _device_get_if_jax(value):

@@ -83,13 +83,26 @@ class TaskSpec:
 def encode_args(args, kwargs, put_fn, inline_limit: int = 100 * 1024):
     """Encode call args: ObjectRefs pass by reference; values serialize inline
     when small, else spill to the object store via put_fn(value)->ObjectRef
-    (reference behavior: direct_task_transport inlines small args)."""
+    (reference behavior: direct_task_transport inlines small args). Inline
+    payloads past the wire's OOB threshold stay as SerializedObjects so
+    push_task frames write their buffers straight from the source memory
+    via the v2 out-of-band segment table (zero-copy; the worker maps them
+    back as views over the frame body). Zero-copy rule: treat task args as
+    immutable until the task settles — a retry re-sends the same views."""
+    from ray_tpu.core import rpc
+    from ray_tpu.core.config import _config
+
     def enc(v):
         if isinstance(v, ObjectRef):
             return (ARG_REF, v)
         s = serialization.serialize(v)
         if s.total_bytes() > inline_limit:
             return (ARG_REF, put_fn(v))
+        if s.total_bytes() >= _config.rpc_oob_threshold_bytes:
+            # the SerializedObject itself rides the frame pickler: its
+            # buffers go out-of-band straight from their source memory (no
+            # to_bytes flatten here, no from_buffer re-parse on the worker)
+            return (ARG_VALUE, s)
         return (ARG_VALUE, s.to_bytes())
 
     return [enc(a) for a in args], {k: enc(v) for k, v in kwargs.items()}
@@ -97,6 +110,8 @@ def encode_args(args, kwargs, put_fn, inline_limit: int = 100 * 1024):
 
 def decode_args(enc_args, enc_kwargs, get_fn):
     """get_fn(list_of_refs) -> list_of_values (batched dependency fetch)."""
+    from ray_tpu.core import rpc
+
     refs = [v for (t, v) in enc_args if t == ARG_REF]
     refs += [v for (t, v) in enc_kwargs.values() if t == ARG_REF]
     fetched = iter(get_fn(refs)) if refs else iter(())
@@ -107,6 +122,9 @@ def decode_args(enc_args, enc_kwargs, get_fn):
     def dec(t, v):
         if t == ARG_REF:
             return resolved[id(v)]
+        v = rpc.unwrap_oob(v)
+        if isinstance(v, serialization.SerializedObject):
+            return serialization.deserialize(v)
         return serialization.loads(v)
 
     args = [dec(t, v) for (t, v) in enc_args]

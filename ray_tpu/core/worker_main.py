@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import logging
 import os
 import threading
@@ -32,9 +33,18 @@ logger = logging.getLogger(__name__)
 class WorkerAgent(CoreWorker):
     def __init__(self, gcs_address, raylet_address, session, node_id):
         super().__init__(gcs_address, raylet_address, session, node_id, mode="worker")
+        # Plain-task execution: one RUNNING task at a time (the slot), but a
+        # wide thread pool so a task blocked in get() can hand its slot to
+        # the next pipelined task instead of starving it (the in-process
+        # mirror of the raylet's blocked-worker resource release — without
+        # it, pipelined submission deadlocks on tasks-that-get-tasks).
+        # Actor workers swap in a dedicated serial pool at init: actor-call
+        # ordering relies on the executor serializing, never on this slot.
         self._exec_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="task-exec"
+            max_workers=64, thread_name_prefix="task-exec"
         )
+        self._exec_slot = threading.Semaphore(1)
+        self._slot_state = threading.local()
         # actor state
         self.actor_instance = None
         self.actor_id: Optional[bytes] = None
@@ -63,15 +73,55 @@ class WorkerAgent(CoreWorker):
         return reply
 
     # --------------------------------------------------------------- tasks
-    async def handle_push_task(self, conn, spec_blob):
-        spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
+    async def handle_push_task(self, conn, spec=None, spec_blob=None):
+        # specs arrive as objects in the frame payload (possibly many per
+        # BATCH frame); spec_blob kept for pre-batching callers
+        spec: ts.TaskSpec = spec if spec is not None else cloudpickle.loads(
+            spec_blob)
         logger.debug("push_task %s %s", spec.name, spec.task_id.hex()[:8])
         loop = asyncio.get_running_loop()
         if spec.streaming:
             return await loop.run_in_executor(
-                self._exec_pool, self._execute_streaming, spec, conn
+                self._exec_pool, self._run_slotted,
+                self._execute_streaming, spec, conn,
             )
-        return await loop.run_in_executor(self._exec_pool, self._execute, spec)
+        return await loop.run_in_executor(
+            self._exec_pool, self._run_slotted, self._execute, spec
+        )
+
+    def _run_slotted(self, fn, *args):
+        """Run one pushed task under the single execution slot. The slot —
+        not the pool width — is what keeps plain-task execution serial;
+        get_blocking hands it over for the duration of a blocking get.
+        A task that cannot take the slot within worker_requeue_after_ms
+        bounces back to the owner for resubmission elsewhere (bounded
+        commitment: a long/blocking peer must not pin queued tasks)."""
+        if not self._exec_slot.acquire(
+                timeout=max(0.0, _config.worker_requeue_after_ms) / 1000.0):
+            return {"requeue": True}
+        self._slot_state.held = True
+        try:
+            return fn(*args)
+        finally:
+            if getattr(self._slot_state, "held", False):
+                self._slot_state.held = False
+                self._exec_slot.release()
+
+    @contextlib.contextmanager
+    def _yield_exec_slot(self):
+        """While the current task blocks (get, stream credit wait), release
+        the execution slot so the next pipelined task runs; re-acquire
+        before resuming. No-op off the slotted plain-task path."""
+        yielded = getattr(self._slot_state, "held", False)
+        if yielded:
+            self._slot_state.held = False
+            self._exec_slot.release()
+        try:
+            yield
+        finally:
+            if yielded:
+                self._exec_slot.acquire()
+                self._slot_state.held = True
 
     def _env_applier(self):
         if self._applier is None:
@@ -108,10 +158,12 @@ class WorkerAgent(CoreWorker):
             pass
 
     def get_blocking(self, refs, timeout):
-        """get() that tells the raylet this worker is blocked meanwhile."""
+        """get() that tells the raylet this worker is blocked meanwhile,
+        and hands the execution slot to the next pipelined task."""
         self._notify_blocked(True)
         try:
-            return self.get(refs, timeout)
+            with self._yield_exec_slot():
+                return self.get(refs, timeout)
         finally:
             self._notify_blocked(False)
 
@@ -133,7 +185,11 @@ class WorkerAgent(CoreWorker):
                     # hiccup) must still be rolled back by the finally-reset
                     applied = True
                     self._env_applier().apply(spec.runtime_env)
-                fn = self.io.run(self.load_function(spec.fn_id))
+                # cache hit stays on this thread: io.run costs two cross-
+                # thread hops, which dominate a short task's wall time
+                fn = self._fn_cache.get(spec.fn_id)
+                if fn is None:
+                    fn = self.io.run(self.load_function(spec.fn_id))
                 args, kwargs = ts.decode_args(
                     spec.args, spec.kwargs,
                     lambda refs: self.get_blocking(refs, None),
@@ -210,11 +266,17 @@ class WorkerAgent(CoreWorker):
             data = ser.to_bytes()
             granted.extend(self._grant_result_borrows(spec, ser.contained_refs))
             if len(data) <= _config.max_direct_call_object_size:
-                entries.append(("inline", data))
+                # large inline results ride the reply frame's out-of-band
+                # segment table: written from `data`, mapped zero-copy by
+                # the owner (no re-pickle of the serialized bytes)
+                if len(data) >= _config.rpc_oob_threshold_bytes:
+                    entries.append(("inline", rpc.Oob(data)))
+                else:
+                    entries.append(("inline", data))
             else:
                 self.shm.put_bytes(oid, data)
                 if self.raylet:
-                    self.io.spawn(self._notify_object_added(oid, len(data)))
+                    self._notify_object_added(oid, len(data))
                 entries.append(
                     (
                         "location",
@@ -290,7 +352,9 @@ class WorkerAgent(CoreWorker):
                 applied = True
                 self._env_applier().apply(spec.runtime_env)
             with self._task_ctx(spec):
-                fn = self.io.run(self.load_function(spec.fn_id))
+                fn = self._fn_cache.get(spec.fn_id)
+                if fn is None:
+                    fn = self.io.run(self.load_function(spec.fn_id))
                 args, kwargs = ts.decode_args(
                     spec.args, spec.kwargs,
                     lambda refs: self.get_blocking(refs, None),
@@ -357,13 +421,15 @@ class WorkerAgent(CoreWorker):
             )
 
         async def _start(index: int, kind: str, payload):
-            return await conn.call_start(
+            # batched: consecutive item pushes staged in one loop tick share
+            # a multi-item BATCH frame and one gather-write
+            return await conn.call_start_batched(
                 "stream_item", **_payload(index, kind, payload, True)
             )
 
         async def _notify(index: int, kind: str, payload):
             try:
-                await conn.notify(
+                await conn.notify_batched(
                     "stream_item", **_payload(index, kind, payload, False)
                 )
             except rpc.ConnectionLost:
@@ -381,7 +447,8 @@ class WorkerAgent(CoreWorker):
                 return inner.result(), True
             if not block:
                 return None, False
-            return self.io.run(_await(inner), timeout=None), True
+            with self._yield_exec_slot():  # credit-gated: may block long
+                return self.io.run(_await(inner), timeout=None), True
 
         def _send(index: int, kind: str, payload) -> bool:
             """Push one item WITHOUT waiting for the write (the io loop owns
@@ -502,11 +569,13 @@ class WorkerAgent(CoreWorker):
         )
         data = ser.to_bytes()
         if len(data) <= _config.max_direct_call_object_size:
+            if len(data) >= _config.rpc_oob_threshold_bytes:
+                return "inline", rpc.Oob(data)  # zero-copy off the frame
             return "inline", data
         oid = ObjectID.for_task_return(spec.task_id, index)
         self.shm.put_bytes(oid, data)
         if self.raylet:
-            self.io.spawn(self._notify_object_added(oid, len(data)))
+            self._notify_object_added(oid, len(data))
         return "location", {
             "session": self.session,
             "raylet_addr": self.raylet_address,
@@ -527,10 +596,11 @@ class WorkerAgent(CoreWorker):
             )
             opts = spec.actor_options or {}
             n = max(1, opts.get("max_concurrency", 1))
-            if n > 1:
-                self._exec_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=n, thread_name_prefix="actor-exec"
-                )
+            # always replace the (wide) plain-task pool: actor-call ordering
+            # relies on the executor itself serializing at max_concurrency
+            self._exec_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="actor-exec"
+            )
             self.actor_instance = cls(*args, **kwargs)
             self._actor_ready.set()
             self.io.run(
@@ -556,12 +626,19 @@ class WorkerAgent(CoreWorker):
             finally:
                 os._exit(1)
 
-    async def handle_push_actor_task(self, conn, spec_blob):
-        """Execute an actor call. Ordering: each owner sends one call at a
-        time (owner-side FIFO queue), and the executor pool serializes
-        execution, so arrival order == submission order per owner."""
-        spec: ts.TaskSpec = cloudpickle.loads(spec_blob)
+    async def handle_push_actor_task(self, conn, spec=None, spec_blob=None):
+        """Execute an actor call. Ordering: each owner enqueues frames in
+        seq order (BATCH frames dispatch their requests in list order), and
+        the executor pool serializes execution, so arrival order ==
+        submission order per owner."""
+        spec: ts.TaskSpec = spec if spec is not None else cloudpickle.loads(
+            spec_blob)
         loop = asyncio.get_running_loop()
+        # wait for init HERE (not in the executor): dispatch must land on the
+        # actor's dedicated serial pool, which _init_actor installs — an early
+        # push run on the wide plain-task pool would dodge the ordering queue
+        while not self._actor_ready.is_set():
+            await asyncio.sleep(0.01)
         if spec.streaming:
             return await loop.run_in_executor(
                 self._exec_pool, self._execute_actor_streaming, spec, conn

@@ -29,7 +29,7 @@ def timeit(name: str, fn: Callable[[], int], duration: float = 2.0) -> Dict:
     return {"name": name, "ops_per_s": round(rate, 1)}
 
 
-def main(duration: float = 2.0):
+def main(duration: float = 2.0, json_path: str = ""):
     import ray_tpu
 
     ray_tpu.shutdown()
@@ -41,6 +41,13 @@ def main(duration: float = 2.0):
     results.append(timeit(
         "put small (1 KiB)", lambda: sum(1 for _ in range(20)
                                          if ray_tpu.put(small)), duration))
+
+    def put_batched():
+        n = 64
+        ray_tpu.put_many([small] * n)
+        return n
+
+    results.append(timeit("put small (batched x64)", put_batched, duration))
     ref_small = ray_tpu.put(small)
     results.append(timeit(
         "get small (1 KiB)", lambda: sum(1 for _ in range(20)
@@ -76,6 +83,18 @@ def main(duration: float = 2.0):
 
     results.append(timeit("task throughput (50 in flight)", batch_tasks, duration))
 
+    # The PR-6 regression guard, visible at a glance: in-flight submission
+    # must beat sync by a wide margin, or the dispatch plane is serializing
+    # where it should pipeline (it briefly dipped BELOW 1.0x before the
+    # coalesced wire landed).
+    sync_rate = results[-2]["ops_per_s"]
+    inflight_rate = results[-1]["ops_per_s"]
+    ratio = inflight_rate / max(sync_rate, 1e-9)
+    print(f"{'task inflight/sync ratio':<42s} {ratio:>11.2f}x")
+    results.append({
+        "name": "task inflight/sync ratio", "ratio": round(ratio, 2),
+    })
+
     # -------------------------------------------------------------- actors
     @ray_tpu.remote
     class Counter:
@@ -100,6 +119,21 @@ def main(duration: float = 2.0):
 
     results.append(timeit(
         "actor calls (100 in flight, pipelined)", batch_actor_calls, duration))
+
+    # same burst on a fresh actor, named for what the wire now does: the
+    # 100 push_actor_task frames staged in one loop tick ride multi-spec
+    # BATCH frames and a single gather-write per flush
+    actor2 = Counter.remote()
+    ray_tpu.get(actor2.inc.remote())
+
+    def batch_actor_calls_coalesced():
+        n = 100
+        ray_tpu.get([actor2.inc.remote() for _ in range(n)])
+        return n
+
+    results.append(timeit(
+        "actor calls (100 in flight, coalesced wire)",
+        batch_actor_calls_coalesced, duration))
 
     # ------------------------------------------- compiled execution graphs
     # Dispatch overhead of a 3-stage actor pipeline: interpreted
@@ -165,7 +199,11 @@ def main(duration: float = 2.0):
     # ----------------------------------------------------- tracing overhead
     _tracing_overhead_benchmarks(ray_tpu, results, duration)
 
-    print(json.dumps({"microbenchmark": results}))
+    payload = {"microbenchmark": results}
+    print(json.dumps(payload))
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(payload, f, indent=2)
     return results
 
 
@@ -280,4 +318,12 @@ def _tracing_overhead_benchmarks(ray_tpu, results, duration: float):
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds per benchmark")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="also write the results JSON to PATH")
+    ns = ap.parse_args()
+    main(duration=ns.duration, json_path=ns.json)

@@ -112,5 +112,14 @@ def list_objects() -> List[Dict[str, Any]]:
 
 
 def summarize_metrics() -> Dict[str, Any]:
-    """Cluster-level counters (nodes, actors, task states)."""
-    return _gcs_call("get_metrics")
+    """Cluster-level counters (nodes, actors, task states), plus this
+    process's RPC wire counters (`rpc_frames_sent`, `rpc_bytes_sent`,
+    `rpc_frames_coalesced`, `rpc_oob_bytes`, ...) — the dispatch plane
+    lives in the calling driver, so its coalescing/zero-copy telemetry is
+    reported from here, not the GCS."""
+    from ray_tpu.core import rpc
+
+    m = _gcs_call("get_metrics")
+    if isinstance(m, dict):
+        m.update(rpc.stats_snapshot())
+    return m

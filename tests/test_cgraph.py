@@ -182,6 +182,40 @@ def test_shm_channel_rejects_oversized_messages(tmp_path):
     ch.unlink()
 
 
+def test_shm_channel_zero_copy_reads(tmp_path):
+    """With zero_copy_reads on, large numpy payloads come back as READ-ONLY
+    views over the ring's mmap (no copy out); a view is valid until the next
+    read on the channel drains another message over it."""
+    np = pytest.importorskip("numpy")
+    from ray_tpu.cgraph import ShmChannel
+
+    ch = ShmChannel(str(tmp_path / "c"), capacity=1 << 16, max_msgs=4,
+                    create=True)
+    ch.zero_copy_reads = True
+    src = np.arange(2048, dtype=np.int64)
+    ch.write({"arr": src})
+
+    out = ch.read(timeout=5)["arr"]
+    assert np.array_equal(out, src)
+    assert not out.flags.writeable  # view over the ring, not a copy
+    with pytest.raises(ValueError):
+        out[0] = -1
+
+    # lifetime rule: the slot is only released by the NEXT read, after
+    # which the ring may recycle the bytes under the old view
+    first = out.copy()
+    for i in range(4):
+        ch.write({"arr": src + i})
+        assert np.array_equal(ch.read(timeout=5)["arr"], src + i)
+    assert np.array_equal(first, src)  # the copy we took is untouched
+
+    # copy-mode reads stay writable (default path unchanged)
+    ch.zero_copy_reads = False
+    ch.write({"arr": src})
+    assert ch.read(timeout=5)["arr"].flags.writeable
+    ch.unlink()
+
+
 def test_error_propagates_and_pipeline_stays_aligned(ray_start_local):
     import ray_tpu
     from ray_tpu.dag import InputNode

@@ -30,8 +30,16 @@ def _gcs_hostport(ray):
 
 
 def _frame(obj) -> bytes:
-    data = pickle.dumps(obj, protocol=5)
-    return struct.pack("<Q", len(data)) + data
+    from ray_tpu.core import rpc
+
+    return rpc.encode_frame_bytes(obj)
+
+
+def _preamble(token: bytes) -> bytes:
+    from ray_tpu.core import rpc
+
+    body = rpc._AUTH_MAGIC + token
+    return struct.pack("<Q", len(body)) + body
 
 
 def test_cluster_has_token(cluster):
@@ -55,8 +63,7 @@ def test_wrong_token_rejected(cluster):
     host, port = _gcs_hostport(cluster)
     s = socket.create_connection((host, port), timeout=5)
     s.settimeout(5)
-    bad = b"RAYTPU-AUTH1 " + b"f" * 32
-    s.sendall(struct.pack("<Q", len(bad)) + bad)
+    s.sendall(_preamble(b"f" * 32))
     s.sendall(_frame((0, 1, "get_nodes", {})))
     assert s.recv(4096) == b"", "server must drop wrong-token peers"
     s.close()
@@ -68,8 +75,7 @@ def test_correct_token_accepted(cluster):
     host, port = _gcs_hostport(cluster)
     s = socket.create_connection((host, port), timeout=10)
     s.settimeout(10)
-    good = b"RAYTPU-AUTH1 " + rpc.get_auth_token().encode()
-    s.sendall(struct.pack("<Q", len(good)) + good)
+    s.sendall(_preamble(rpc.get_auth_token().encode()))
     s.sendall(_frame((0, 1, "get_nodes", {})))
     hdr = s.recv(8)
     assert len(hdr) == 8, "authed peer must get a response"
