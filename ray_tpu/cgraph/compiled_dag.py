@@ -101,6 +101,32 @@ class _RecoverNeeded(Exception):
     graph was compiled with auto_recover=True — run recover() and retry."""
 
 
+_exec_hist = None
+
+
+def _observe_execute_ms(dur_ms: float) -> None:
+    """cgraph SLO series: execute() submit -> first successful get(). The
+    observation point is the caller's get(), so any delay the caller adds
+    between submit and get is included — for the request/response usage the
+    SLO plane charts (CompiledDeploymentHandle.remote().get(), sync
+    pipelines) that IS the completion latency; deep fire-and-forget
+    pipelines should read their stage timings from the tracing plane
+    instead. Lazy + config-gated like the serve series."""
+    global _exec_hist
+    if not _config.metrics_enabled:
+        return
+    if _exec_hist is None:
+        from ray_tpu.util import metrics as m
+
+        _exec_hist = m.Histogram(
+            "cgraph_execute_ms",
+            "compiled-graph execute() submit -> first get() returning "
+            "(includes any caller delay before get)",
+            boundaries=m.LATENCY_MS_BOUNDS,
+        )
+    _exec_hist.observe(dur_ms)
+
+
 class CompiledDAGRef:
     """Result handle for one ``execute()`` call; ``get()`` blocks on the
     output channel. The first successful get() moves the result out of the
@@ -115,6 +141,7 @@ class CompiledDAGRef:
         self._seq = seq
         self._value = CompiledDAGRef._UNSET
         self._error: Optional[BaseException] = None
+        self._submit_ts: Optional[float] = None  # set by _execute_attempt
 
     def get(self, timeout: Optional[float] = None):
         if self._error is not None:
@@ -128,6 +155,11 @@ class CompiledDAGRef:
         except BaseException as e:
             self._error = e
             raise
+        if self._submit_ts is not None:
+            import time as _time
+
+            _observe_execute_ms((_time.monotonic() - self._submit_ts) * 1000)
+            self._submit_ts = None
         return self._value
 
     def __del__(self):
@@ -657,6 +689,7 @@ class CompiledDAG:
             seq = self._submitted
             self._submitted += 1
             ref = CompiledDAGRef(self, seq)
+            ref._submit_ts = _time.monotonic()
             self._issued_refs[seq] = weakref.ref(ref)
             return ref
 

@@ -147,7 +147,23 @@ class Config:
     task_events_flush_interval_ms: int = 1_000
     # GCS-side retention: max tasks kept in the aggregator (oldest evicted)
     task_events_max_tasks: int = 10_000
+    # per-job retention: a chatty job evicts its own oldest tasks before it
+    # can push another job's history out of the aggregator
+    task_events_max_tasks_per_job: int = 5_000
+    # crash forensics: workers append each recorded event to a per-worker
+    # WAL file in the session dir before the periodic flush; the raylet
+    # recovers a SIGKILLed worker's orphaned WAL into the aggregator so the
+    # final second of spans still closes its timeline
+    task_events_wal_enabled: bool = True
     metrics_report_interval_ms: int = 2_000
+    # master switch for the built-in hot-path instrumentation (serve
+    # latency histograms, raylet lease-grant latency, cgraph/streaming
+    # series); user-defined metrics are unaffected
+    metrics_enabled: bool = True
+    # how many merged snapshots the GCS (and local backend) keep as the
+    # metrics time series, sampled every metrics_report_interval_ms
+    # (240 x 2s = 8 minutes of history by default)
+    metrics_timeseries_depth: int = 240
 
     def __post_init__(self):
         for f in fields(self):

@@ -244,6 +244,9 @@ class CoreWorker:
         self.gcs: Optional[rpc.Connection] = None
         self.raylet: Optional[rpc.Connection] = None
         self.address: Optional[str] = None
+        # driver: GCS-assigned job id; workers tag submissions with the
+        # EXECUTING task's job instead (tracing.current_job_id())
+        self.job_id: Optional[str] = None
         self._lock = threading.Lock()
         # actor lifecycle listeners fed by the GCS "actor" pubsub channel
         # (compiled graphs subscribe their participants here)
@@ -276,7 +279,9 @@ class CoreWorker:
                 self.raylet_address, handler=self, name=f"{self.mode}->raylet"
             )
         if self.mode == "driver":
-            await self.gcs.call("register_driver")
+            reply = await self.gcs.call("register_driver")
+            if isinstance(reply, dict) and reply.get("job_id") is not None:
+                self.job_id = f"{reply['job_id']:04x}"
             await self._subscribe_logs()
         for loop_coro in (
             self._flush_task_events_loop(), self._metrics_flush_loop(),
@@ -347,6 +352,8 @@ class CoreWorker:
         while True:
             await asyncio.sleep(period)
             try:
+                # wire counters aggregate cluster-wide as registry Counters
+                rpc.publish_wire_counters()
                 samples = metrics_api.get_registry().collect()
                 if samples and self.gcs is not None and not self.gcs.closed:
                     await self.gcs.notify(
@@ -1047,6 +1054,7 @@ class CoreWorker:
             backpressure=options.generator_backpressure_num_objects,
             trace_id=tracing.current_trace_id(),
             parent_task_id=tracing.current_task_id(),
+            job_id=self.job_id or tracing.current_job_id(),
         )
         self.submitted_specs[task_id] = spec
         self._pin_task_args(task_id, enc_args, enc_kwargs)
@@ -1694,6 +1702,7 @@ class CoreWorker:
             node_id=self.node_id,
             worker=worker or self.address,
             trace_id=getattr(spec, "trace_id", None),
+            job_id=getattr(spec, "job_id", None),
             args=args,
         )
 
@@ -2002,6 +2011,7 @@ class CoreWorker:
             backpressure=options.generator_backpressure_num_objects,
             trace_id=tracing.current_trace_id(),
             parent_task_id=tracing.current_task_id(),
+            job_id=self.job_id or tracing.current_job_id(),
         )
         self._record_task_event(spec, "SUBMITTED")
         out = None

@@ -136,8 +136,13 @@ class GcsServer:
         self.metrics: Dict[str, int] = {}
         # metrics plane: {source: (ts, [series snapshots])} flushed by every
         # process's registry (util/metrics.py); dashboard /metrics renders
-        # the merge. In-memory only — time series storage is Prometheus's job.
+        # the merge, and a bounded ring of merged snapshots (sampled every
+        # metrics_report_interval_ms) backs get_metrics_timeseries — "what
+        # was p99 five minutes ago" without an external Prometheus.
         self.metric_reports: Dict[str, Tuple[float, list]] = {}
+        from ray_tpu.util.metrics import MetricsTimeSeries
+
+        self.timeseries = MetricsTimeSeries()
         self._store_dirty = True  # durable-table mutation since last snapshot
         self._actor_events: Dict[bytes, asyncio.Event] = {}  # get_actor waits
 
@@ -153,6 +158,7 @@ class GcsServer:
         chaos.set_exit_callback(self._chaos_pre_exit)
         await self.server.start()
         self._bg.append(asyncio.create_task(self._health_check_loop()))
+        self._bg.append(asyncio.create_task(self._metrics_sample_loop()))
         if self.store_path:
             self._bg.append(asyncio.create_task(self._snapshot_loop()))
         logger.info("GCS listening on %s", self.server.address)
@@ -725,10 +731,11 @@ class GcsServer:
         self.metric_reports[source] = (time.time(), samples)
         return True
 
-    def handle_collect_metrics(self, conn):
-        """Cluster-wide merged user+core metrics plus the GCS's own counters
-        (as a synthetic source), for the dashboard's /metrics endpoint."""
-        from ray_tpu.util.metrics import merge_snapshots
+    def _merged_metrics(self) -> list:
+        """Cluster-wide merge: every reported registry + the GCS's own
+        synthetic counters/gauges + the GCS process's own metrics registry
+        (the task-duration histograms the aggregator derives live there)."""
+        from ray_tpu.util.metrics import get_registry, merge_snapshots
 
         gcs_series = [
             {
@@ -751,10 +758,36 @@ class GcsServer:
             }
             for k, v in gauges.items()
         ]
-        merged = merge_snapshots(
-            {**self.metric_reports, "gcs": (time.time(), gcs_series)}
-        )
-        return merged
+        now = time.time()
+        return merge_snapshots({
+            **self.metric_reports,
+            "gcs": (now, gcs_series),
+            "gcs-process": (now, get_registry().collect()),
+        })
+
+    def handle_collect_metrics(self, conn):
+        """Cluster-wide merged user+core metrics, for the dashboard's
+        /metrics endpoint."""
+        return self._merged_metrics()
+
+    def handle_get_metrics_timeseries(self, conn, names=None, limit=None):
+        """Bounded history of merged snapshots (one every
+        metrics_report_interval_ms): [{"ts", "series"}...], newest last."""
+        return self.timeseries.query(names=names, limit=limit)
+
+    async def _metrics_sample_loop(self):
+        """Sample the cluster-wide merge into the bounded time-series ring
+        (the retention layer behind get_metrics_timeseries)."""
+        from ray_tpu.core import rpc as rpc_mod
+
+        period = max(_config.metrics_report_interval_ms, 100) / 1000
+        while True:
+            await asyncio.sleep(period)
+            try:
+                rpc_mod.publish_wire_counters()
+                self.timeseries.sample(self._merged_metrics())
+            except Exception:  # noqa: BLE001 - sampling must never kill GCS
+                logger.exception("metrics sample loop error")
 
     async def handle_publish_logs(self, conn, batch: dict):
         """A raylet's log monitor pushed a batch of worker log lines; fan

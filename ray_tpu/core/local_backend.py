@@ -113,15 +113,60 @@ class LocalBackend(Backend):
         self._cancelled: set = set()
         self._actor_listeners: List[Any] = []
         # tracing: local mode has no GCS — the process buffer drains into an
-        # in-process aggregator on every state query (no flush thread)
+        # in-process aggregator on every state query (no flush thread).
+        # Drop accounting is baselined at backend construction: the buffer
+        # is process-global, and THIS backend's aggregator must not report
+        # overflow from before it existed (same rule as the cluster flush
+        # loop in tracing.events.flush_task_events_loop).
         self._events = tracing.get_buffer()
         self._events.set_identity("local", f"local-{self.worker_id.hex()[:8]}")
         self._aggregator = tracing.TaskEventAggregator()
+        self._drop_baseline = self._events.dropped
         # task_id hex → task name, so a death path (which only has refs)
         # can still record a named FAILED event
         self._task_names: Dict[str, str] = {}
+        # metrics time series (cluster parity: the GCS samples its merge on
+        # the same period) — a daemon thread so local mode answers
+        # get_metrics_timeseries with real history, making the retention
+        # layer tier-1-testable
+        from ray_tpu.util.metrics import MetricsTimeSeries
+
+        self._timeseries = MetricsTimeSeries()
+        self._ts_stop = threading.Event()
+        threading.Thread(
+            target=self._timeseries_loop, daemon=True,
+            name="local-metrics-ts",
+        ).start()
         # chaos "kill" actions executed on an actor thread route here
         chaos.set_local_actor_killer(self._chaos_kill_current)
+
+    def _timeseries_loop(self):
+        from ray_tpu.core.config import _config
+
+        last = 0.0
+        # short wait slices so a test shrinking metrics_report_interval_ms
+        # takes effect immediately (the period is re-read every slice)
+        while not self._ts_stop.wait(0.1):
+            period = max(_config.metrics_report_interval_ms, 100) / 1000
+            now = time.monotonic()
+            if now - last < period:
+                continue
+            last = now
+            try:
+                self._timeseries.sample(self._merged_metrics())
+            except Exception:  # noqa: BLE001 - sampling must never break us
+                pass
+
+    def _merged_metrics(self):
+        # local mode: everything runs in-process, so the local registry IS
+        # the cluster-wide view
+        import time as _time
+
+        from ray_tpu.util.metrics import get_registry, merge_snapshots
+
+        return merge_snapshots(
+            {"local": (_time.time(), get_registry().collect())}
+        )
 
     # ------------------------------------------------- actor lifecycle plane
     def _emit_actor_event(self, actor_id: ActorID, state: str, reason: str = ""):
@@ -248,7 +293,10 @@ class LocalBackend(Backend):
 
     def _sync_events(self):
         events, dropped = self._events.drain()
-        self._aggregator.ingest(events, dropped=dropped, source="local")
+        self._aggregator.ingest(
+            events, dropped=max(0, dropped - self._drop_baseline),
+            source="local",
+        )
         return self._aggregator
 
     # ------------------------------------------------------------------ utils
@@ -805,18 +853,32 @@ class LocalBackend(Backend):
             m.update(self._sync_events().stats())
             return m
         if method == "collect_metrics":
-            # local mode: everything runs in-process, so the local registry
-            # IS the cluster-wide view
+            return self._merged_metrics()
+        if method == "get_metrics_timeseries":
+            # append a fresh sample to the RESULT (not the ring) so a
+            # just-recorded metric is queryable without waiting out the
+            # sampling period — polling queries must not evict the ring's
+            # periodic history (the cluster-mode retention contract)
             import time as _time
 
-            from ray_tpu.util.metrics import get_registry, merge_snapshots
-
-            return merge_snapshots(
-                {"local": (_time.time(), get_registry().collect())}
-            )
+            names = kwargs.get("names")
+            limit = kwargs.get("limit")
+            out = self._timeseries.query(names=names, limit=limit)
+            series = self._merged_metrics()
+            if names is not None:
+                keep = set(names)
+                series = [s for s in series if s["name"] in keep]
+            out = out + [{"ts": _time.time(), "series": series}]
+            # the fresh sample counts toward the limit: both backends
+            # honor "at most `limit` samples" (limit=0 means none)
+            if limit is None:
+                return out
+            limit = int(limit)
+            return out[-limit:] if limit > 0 else []
         raise ValueError(f"unknown state method {method!r}")
 
     def shutdown(self):
+        self._ts_stop.set()
         chaos.set_local_actor_killer(None)
         for a in list(self._actors.values()):
             a.stop()

@@ -112,6 +112,22 @@ class Raylet:
         # lease_id → (pg_id, bundle_index) for PG leases: blocked-worker
         # re-acquire must draw from the SAME bundle, not node availability
         self._lease_pg: Dict[str, Tuple[Optional[bytes], int]] = {}
+        self._m_lease_grant = None  # queued->granted latency histogram
+
+    def _observe_lease_grant(self, lease: LeaseRequest) -> None:
+        if not _config.metrics_enabled:
+            return
+        if self._m_lease_grant is None:
+            from ray_tpu.util import metrics as metrics_api
+
+            self._m_lease_grant = metrics_api.Histogram(
+                "raylet_lease_grant_ms",
+                "lease request queued -> worker granted",
+                boundaries=metrics_api.LATENCY_MS_BOUNDS,
+            )
+        self._m_lease_grant.observe(
+            (time.monotonic() - lease.queued_at) * 1000
+        )
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -170,6 +186,7 @@ class Raylet:
         )
         self._bg.append(asyncio.create_task(self._metrics_flush_loop()))
         self._bg.append(asyncio.create_task(self._task_events_flush_loop()))
+        self._bg.append(asyncio.create_task(self._orphan_wal_scan_loop()))
         if _config.enable_worker_prestart:
             n = min(2, int(self.total.get("CPU")) or 1)
             for _ in range(n):
@@ -287,6 +304,9 @@ class Raylet:
         period = max(_config.metrics_report_interval_ms, 100) / 1000
         while True:
             try:
+                rpc.publish_wire_counters()
+                # raylet_pending_leases IS the sched-queue-depth series
+                # (SLO dashboards/CLI read it by that name)
                 g_pending.set(len(self.pending_leases))
                 g_active.set(len(self.active_leases))
                 by_state: Dict[str, int] = {}
@@ -648,6 +668,7 @@ class Raylet:
             worker.lease_id = lease.lease_id
             self.active_leases[lease.lease_id] = (lease.demand, worker, token)
             self._disp["grants"] += 1
+            self._observe_lease_grant(lease)
             if lease.pg_id is not None:
                 self._lease_pg[lease.lease_id] = (lease.pg_id, lease.bundle_index)
             self.pending_leases.remove(lease)
@@ -721,6 +742,7 @@ class Raylet:
         return reply
 
     async def _on_worker_death(self, handle: WorkerHandle):
+        await self._recover_worker_wal(handle)
         if handle.lease_id:
             self.handle_return_lease(None, handle.lease_id)
         if handle.actor_id is not None:
@@ -736,6 +758,140 @@ class Raylet:
                 )
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
+
+    async def _recover_worker_wal(self, handle: WorkerHandle):
+        """Crash forensics: a dead worker's unflushed TaskEventBuffer died
+        with it — but its WAL (appended per event, truncated on successful
+        flush) survives in the session dir. Forward the orphaned tail to the
+        aggregator so a SIGKILLed worker's final spans (RUNNING states,
+        profile spans from the last second) still close its timeline, then
+        delete the file (recovery is one-shot)."""
+        if not _config.task_events_wal_enabled:
+            return
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        path = os.path.join(
+            session_dir(self.session), "task_wal",
+            f"wal-{self.node_id}-{handle.startup_token}.jsonl",
+        )
+        try:
+            events = tracing.read_wal(path)
+        except Exception:  # noqa: BLE001 - forensics must not break reaping
+            logger.exception("WAL parse failed for %s", path)
+            return
+        if not events:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+        # deliver BEFORE unlinking: if the GCS is unreachable right now,
+        # the file stays and the orphan sweep retries once it is back
+        # (replay is idempotent — the aggregator dedups wal- sources)
+        if not await self._report_wal_events(
+            events, f"wal-{self.node_id}-{handle.startup_token}"
+        ):
+            return
+        logger.info(
+            "recovered %d task events from dead worker token=%s WAL",
+            len(events), handle.startup_token,
+        )
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    async def _report_wal_events(self, events, source: str) -> bool:
+        if self.gcs is None or self.gcs.closed:
+            return False
+        try:
+            await self.gcs.notify(
+                "report_task_events", events=events, dropped=0,
+                source=source,
+            )
+            return True
+        except (rpc.RpcError, rpc.ConnectionLost):
+            return False
+
+    def _wal_node_of(self, name: str) -> Optional[str]:
+        """Node id embedded in a WAL filename (wal-<node>-<token>.jsonl)."""
+        if not (name.startswith("wal-") and name.endswith(".jsonl")):
+            return None
+        body = name[len("wal-"):-len(".jsonl")]
+        node, sep, token = body.rpartition("-")
+        return node if sep and token.isdigit() else None
+
+    def _wal_claimable(self, name: str, live: set) -> bool:
+        """May this raylet recover ``name``? Our own node's files: yes,
+        unless a live worker owns them. A peer node's files: only when the
+        cluster view says that node is NOT alive — a live peer's worker may
+        merely be partitioned from the GCS (its flush loop stopped
+        truncating), and stealing its WAL would lose exactly the events it
+        exists to preserve. With no view (our own GCS partition) we claim
+        nothing foreign — the sweep retries forever, so recovery is only
+        deferred, never lost."""
+        if name in live:
+            return False
+        node = self._wal_node_of(name)
+        if node is None:
+            return False
+        if node == self.node_id:
+            return True
+        # unknown node = no raylet ever registered it with our GCS view =
+        # no live owner (workers die with their raylet); known-and-alive
+        # peers keep their files even when stale (GCS-partitioned worker)
+        peer = self.cluster_view.get(node)
+        return peer is None or not peer.get("alive")
+
+    async def _orphan_wal_scan_loop(self):
+        """Sweep the session's WAL dir for files no live worker owns — the
+        leftovers of a CRASHED raylet (its workers died with it, so no
+        _on_worker_death ever fired) or of a recovery attempt made while
+        the GCS was unreachable. A file is recovered when it is non-empty,
+        stale (no append for >30s), and claimable per _wal_claimable; the
+        file is deleted only after the GCS accepted the events (replay is
+        aggregator-idempotent, so a duplicate race between sweepers is
+        harmless)."""
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        wal_dir = os.path.join(session_dir(self.session), "task_wal")
+        while True:
+            await asyncio.sleep(30.0)
+            if not _config.task_events_wal_enabled:
+                continue
+            try:
+                names = os.listdir(wal_dir)
+            except OSError:
+                continue
+            live = {
+                f"wal-{self.node_id}-{w.startup_token}.jsonl"
+                for w in self.pool.workers.values()
+                if w.state != DEAD
+            }
+            now = time.time()
+            for name in names:
+                if not self._wal_claimable(name, live):
+                    continue
+                path = os.path.join(wal_dir, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                if st.st_size == 0 or now - st.st_mtime < 30.0:
+                    continue
+                events = tracing.read_wal(path)
+                if not events:
+                    continue
+                if not await self._report_wal_events(events, f"wal-{name}"):
+                    continue  # GCS unreachable: leave the file, retry later
+                logger.info(
+                    "recovered %d task events from orphaned WAL %s",
+                    len(events), name,
+                )
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     # -------------------------------------------------------------- actors
     async def handle_create_actor_worker(self, conn, actor_id, spec_blob,

@@ -249,6 +249,34 @@ def stats_snapshot() -> Dict[str, int]:
     return dict(_TOTALS)
 
 
+_PUBLISHED: Dict[str, int] = {}
+_STAT_HELP = {
+    "rpc_frames_sent": "frames written to the wire",
+    "rpc_bytes_sent": "bytes written to the wire",
+    "rpc_frames_coalesced": "frames that shared a gather-write",
+    "rpc_oob_bytes": "bytes sent via out-of-band segment tables",
+    "rpc_flushes": "outbox gather-writes",
+    "rpc_frames_recv": "frames read from the wire",
+}
+
+
+def publish_wire_counters() -> None:
+    """Mirror this process's rpc_* wire totals into the metrics registry as
+    real Counters, so the periodic registry flush carries them to the GCS
+    and they AGGREGATE cluster-wide (fixing the summarize_metrics caveat
+    that dispatch-plane telemetry was only visible from the calling driver).
+    Delta-based: safe to call from any flush loop, any number of times."""
+    from ray_tpu.util import metrics as metrics_api
+
+    for k, v in stats_snapshot().items():
+        prev = _PUBLISHED.get(k, 0)
+        if v > prev:
+            metrics_api.Counter(k, description=_STAT_HELP.get(k, "")).inc(
+                v - prev
+            )
+            _PUBLISHED[k] = v
+
+
 _tracing_mod = None
 
 

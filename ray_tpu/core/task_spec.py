@@ -35,7 +35,10 @@ class TaskSpec:
     num_returns: int
     resources: Dict[str, float]
     owner_addr: str                   # rpc address of the owning worker
-    job_id: bytes = b""
+    # job identity (hex-ish string): rides every task event so the GCS
+    # aggregator can enforce per-job retention; nested submissions inherit
+    # it through the executing worker's task context
+    job_id: Optional[str] = None
     max_retries: int = 0
     retry_exceptions: bool = False
     # actor fields

@@ -169,10 +169,12 @@ class WorkerAgent(CoreWorker):
 
     def _task_ctx(self, spec: ts.TaskSpec):
         """Tracing context for the executing task: nested submissions made
-        by the user function inherit this task as parent and ride the
-        request's trace id (propagated through the spec)."""
+        by the user function inherit this task as parent, ride the
+        request's trace id, and carry the job (all propagated through the
+        spec)."""
         return tracing.task_context(
-            spec.task_id.hex(), getattr(spec, "trace_id", None)
+            spec.task_id.hex(), getattr(spec, "trace_id", None),
+            getattr(spec, "job_id", None),
         )
 
     def _execute(self, spec: ts.TaskSpec) -> dict:
@@ -702,6 +704,20 @@ def main():
     agent = WorkerAgent(gcs, raylet, session, node_id)
     agent.connect()
     agent.register_with_raylet(token)
+
+    # crash forensics: append every task event to a per-worker WAL in the
+    # (tmpfs-backed) shm session dir BEFORE the periodic flush — if this
+    # process is SIGKILLed, the raylet recovers the orphaned file into the
+    # aggregator so the final second of spans still closes the timeline.
+    # tmpfs survives worker death (the failure model covered here) without
+    # paying disk-write latency per event.
+    if _config.task_events_wal_enabled:
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        wal = os.path.join(
+            session_dir(session), "task_wal", f"wal-{node_id}-{token}.jsonl",
+        )
+        tracing.get_buffer().enable_wal(wal)
 
     # make nested @remote calls work inside tasks
     from ray_tpu import api

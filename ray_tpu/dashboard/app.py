@@ -34,6 +34,7 @@ _PAGE = """<!doctype html>
 <body>
 <h1>ray_tpu dashboard</h1>
 <div id="cluster"></div>
+<h2>SLO</h2><div id="slo"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
@@ -44,6 +45,85 @@ function render(tbl, rows, cols) {
   t.innerHTML = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>" +
     rows.map(r => "<tr>" + cols.map(c => `<td>${r[c] ?? ""}</td>`).join("")
     + "</tr>").join("");
+}
+// ---- SLO sparklines over /api/timeseries -------------------------------
+function pts(samples, name) {  // [{ts, value}] summed over tag combos
+  return samples.map(s => {
+    const ser = s.series.find(x => x.name === name);
+    if (!ser) return null;
+    let v = 0; for (const p of ser.points) {
+      v += Array.isArray(p.value) ? p.value[p.value.length - 1] : p.value;
+    }
+    return {ts: s.ts, v};
+  }).filter(Boolean);
+}
+function rate(series) {  // per-second deltas of a cumulative counter
+  const out = [];
+  for (let i = 1; i < series.length; i++) {
+    const dt = series[i].ts - series[i-1].ts;
+    if (dt > 0) out.push(Math.max(0, series[i].v - series[i-1].v) / dt);
+  }
+  return out;
+}
+function pctl(samples, name, q) {  // per-sample percentile of a histogram
+  const out = [];
+  for (let i = 1; i < samples.length; i++) {
+    for (const ser of samples[i].series) {
+      if (ser.name !== name) continue;
+      const prev = (samples[i-1].series.find(x => x.name === name) || ser);
+      const nb = ser.boundaries.length + 1;
+      let cur = new Array(nb).fill(0), old = new Array(nb).fill(0);
+      for (const p of ser.points)
+        p.value.slice(0, nb).forEach((c, k) => cur[k] += c);
+      for (const p of prev.points)
+        p.value.slice(0, nb).forEach((c, k) => old[k] += c);
+      let d = cur.map((c, k) => Math.max(0, c - old[k]));
+      if (d.reduce((a, b) => a + b, 0) === 0) d = cur;
+      const total = d.reduce((a, b) => a + b, 0);
+      if (total === 0) { out.push(0); continue; }
+      let cum = 0, lo = 0, val = ser.boundaries[ser.boundaries.length-1];
+      for (let k = 0; k < ser.boundaries.length; k++) {
+        const prevCum = cum; cum += d[k];
+        if (cum >= q * total) {
+          const f = d[k] ? (q * total - prevCum) / d[k] : 0;
+          val = lo + (ser.boundaries[k] - lo) * f; break;
+        }
+        lo = ser.boundaries[k];
+      }
+      out.push(val);
+    }
+  }
+  return out;
+}
+function spark(label, vals, unit) {
+  const w = 220, h = 36, max = Math.max(...vals, 1e-9);
+  const step = vals.length > 1 ? w / (vals.length - 1) : w;
+  const line = vals.map((v, i) =>
+    `${(i * step).toFixed(1)},${(h - 2 - (h - 6) * v / max).toFixed(1)}`
+  ).join(" ");
+  const last = vals.length ? vals[vals.length - 1] : 0;
+  return `<span style="display:inline-block;margin:0 1.2rem 0.6rem 0">` +
+    `<b>${label}</b> ${last.toFixed(1)}${unit}<br>` +
+    `<svg width="${w}" height="${h}" style="background:#fff;` +
+    `border:1px solid #ddd"><polyline fill="none" stroke="#36c" ` +
+    `stroke-width="1.5" points="${line}"/></svg></span>`;
+}
+async function slo() {
+  const samples = await j("/api/timeseries");
+  if (!samples.length) return;
+  let html = "";
+  const qps = rate(pts(samples, "serve_requests_total"));
+  if (qps.length) html += spark("serve QPS", qps, "/s");
+  const p99 = pctl(samples, "serve_request_latency_ms", 0.99);
+  if (p99.length) html += spark("serve p99", p99, "ms");
+  const errs = rate(pts(samples, "serve_request_errors_total"));
+  if (errs.length) html += spark("serve errors", errs, "/s");
+  const tq = pctl(samples, "task_e2e_ms", 0.99);
+  if (tq.length) html += spark("task p99", tq, "ms");
+  const depth = pts(samples, "raylet_pending_leases").map(p => p.v);
+  if (depth.length) html += spark("sched queue", depth, "");
+  document.getElementById("slo").innerHTML =
+    html || "(no SLO series yet)";
 }
 async function refresh() {
   const c = await j("/api/cluster");
@@ -56,6 +136,7 @@ async function refresh() {
          ["actor_id", "state", "name", "node_id", "num_restarts"]);
   render("tasks", (await j("/api/tasks")).slice(-50).reverse(),
          ["task_id", "name", "state", "worker", "time"]);
+  await slo();
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
@@ -108,6 +189,39 @@ class Dashboard:
             return {"total": total, "available": avail, "metrics": metrics}
         if path == "/api/load":
             return await self._gcs_call("get_cluster_load")
+        if path.startswith("/api/timeseries"):
+            # GCS ring of merged snapshots; tag-tuple point keys become
+            # JSON-friendly [{"tags": {...}, "value": v}] lists
+            limit = None
+            if "?" in path:
+                from urllib.parse import parse_qs
+
+                q = parse_qs(path.split("?", 1)[1])
+                try:
+                    limit = int(q["limit"][0]) if q.get("limit") else None
+                except ValueError:
+                    limit = None  # malformed limit: serve the full ring
+            samples = await self._gcs_call(
+                "get_metrics_timeseries", limit=limit
+            )
+            return [
+                {
+                    "ts": s["ts"],
+                    "series": [
+                        {
+                            "name": x["name"],
+                            "kind": x["kind"],
+                            "boundaries": x.get("boundaries") or [],
+                            "points": [
+                                {"tags": dict(tags), "value": val}
+                                for tags, val in x["points"].items()
+                            ],
+                        }
+                        for x in s["series"]
+                    ],
+                }
+                for s in samples
+            ]
         return None
 
     async def _handle(self, reader: asyncio.StreamReader,

@@ -199,6 +199,9 @@ def main(duration: float = 2.0, json_path: str = ""):
     # ----------------------------------------------------- tracing overhead
     _tracing_overhead_benchmarks(ray_tpu, results, duration)
 
+    # ----------------------------------------------------- metrics overhead
+    _metrics_overhead_benchmarks(ray_tpu, results, duration)
+
     payload = {"microbenchmark": results}
     print(json.dumps(payload))
     if json_path:
@@ -315,6 +318,69 @@ def _tracing_overhead_benchmarks(ray_tpu, results, duration: float):
             else:
                 os.environ[k] = v
         _config.task_events_enabled, _config.task_events_sample_rate = saved_cfg
+
+
+def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
+    """Serve dispatch throughput with the SLO instrumentation plane (router
+    + replica histograms/counters) and the task-event WAL off and on. Each
+    pass boots a fresh cluster with the config in the environment, so the
+    replica workers honor it too. The PR-8 acceptance bar: instrumentation
+    overhead within box noise on the serve dispatch row."""
+    import os
+
+    from ray_tpu.core.config import _config
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("RAY_TPU_METRICS_ENABLED",
+                  "RAY_TPU_TASK_EVENTS_WAL_ENABLED")
+    }
+    saved_cfg = (_config.metrics_enabled, _config.task_events_wal_enabled)
+    try:
+        for label, metrics_on, wal_on in (
+            ("metrics off, wal off", False, False),
+            ("metrics on, wal off", True, False),
+            ("metrics on, wal on", True, True),
+        ):
+            os.environ["RAY_TPU_METRICS_ENABLED"] = "1" if metrics_on else "0"
+            os.environ["RAY_TPU_TASK_EVENTS_WAL_ENABLED"] = (
+                "1" if wal_on else "0"
+            )
+            _config.metrics_enabled = metrics_on
+            _config.task_events_wal_enabled = wal_on
+            ray_tpu.init(num_cpus=4, num_tpus=0)
+            from ray_tpu import serve
+
+            @serve.deployment
+            class Echo:
+                def __call__(self, x):
+                    return x
+
+            try:
+                handle = serve.run(Echo.bind())
+                assert ray_tpu.get(handle.remote(0), timeout=60) == 0
+
+                def serve_dispatch():
+                    n = 20
+                    refs = [handle.remote(i) for i in range(n)]
+                    for r in refs:
+                        ray_tpu.get(r, timeout=60)
+                    return n
+
+                results.append(timeit(
+                    f"serve dispatch (20 in flight), {label}",
+                    serve_dispatch, duration,
+                ))
+            finally:
+                serve.shutdown()
+                ray_tpu.shutdown()
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _config.metrics_enabled, _config.task_events_wal_enabled = saved_cfg
 
 
 if __name__ == "__main__":

@@ -142,6 +142,111 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def _fmt_num(v, suffix="") -> str:
+    if v is None:
+        return "-"
+    return f"{v:,.1f}{suffix}"
+
+
+def render_metrics_snapshot(samples) -> str:
+    """Top-like text rendering of the SLO time series: one row per serve
+    deployment (QPS / p50 / p99 / exec p99 / errors / inflight) plus the
+    latest node gauges. Pure function of get_metrics_timeseries output so
+    tests can assert on it without a terminal."""
+    from ray_tpu.util.metrics import counter_rate, window_percentile
+
+    lines = []
+    if not samples:
+        return "(no metric samples yet)\n"
+    last = samples[-1]
+
+    def series(name):
+        for s in last["series"]:
+            if s["name"] == name:
+                return s
+        return None
+
+    # deployments seen on any serve series in the latest sample
+    deployments = set()
+    for name in ("serve_requests_total", "serve_request_latency_ms"):
+        s = series(name)
+        if s:
+            for tags in s["points"]:
+                deployments.update(
+                    v for k, v in tags if k == "deployment"
+                )
+    header = (f"{'deployment':<24s} {'qps':>8s} {'p50 ms':>9s} "
+              f"{'p99 ms':>9s} {'exec p99':>9s} {'err/s':>8s} "
+              f"{'inflight':>8s}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for dep in sorted(deployments):
+        tags = {"deployment": dep}
+        qps = counter_rate(samples, "serve_requests_total", tags)
+        p50 = window_percentile(
+            samples, "serve_request_latency_ms", 0.5, tags)
+        p99 = window_percentile(
+            samples, "serve_request_latency_ms", 0.99, tags)
+        ex99 = window_percentile(samples, "serve_exec_latency_ms", 0.99, tags)
+        errs = counter_rate(samples, "serve_request_errors_total", tags)
+        inflight = None
+        s = series("serve_replica_inflight")
+        if s:
+            inflight = sum(
+                v for tags_, v in s["points"].items()
+                if ("deployment", dep) in tags_
+            )
+        lines.append(
+            f"{dep:<24s} {_fmt_num(qps):>8s} {_fmt_num(p50):>9s} "
+            f"{_fmt_num(p99):>9s} {_fmt_num(ex99):>9s} "
+            f"{_fmt_num(errs):>8s} {_fmt_num(inflight):>8s}"
+        )
+    if not deployments:
+        lines.append("(no serve deployments reporting)")
+    # task-plane percentiles + node gauges from the latest sample
+    t99 = window_percentile(samples, "task_e2e_ms", 0.99)
+    if t99 is not None:
+        lines.append("")
+        lines.append(f"task e2e p99: {t99:,.1f} ms   "
+                     f"exec p99: "
+                     f"{_fmt_num(window_percentile(samples, 'task_exec_ms', 0.99))} ms")
+    gauge_names = (
+        "raylet_pending_leases", "raylet_active_leases",
+        "object_store_used_bytes", "object_store_num_objects",
+        "streaming_owner_buffered_items",
+    )
+    gauges = []
+    for name in gauge_names:
+        s = series(name)
+        if s and s["points"]:
+            gauges.append(f"{name}={sum(s['points'].values()):,.0f}")
+    if gauges:
+        lines.append("")
+        lines.append("node gauges: " + "  ".join(gauges))
+    return "\n".join(lines) + "\n"
+
+
+def cmd_metrics(args) -> int:
+    """Top-like SLO view over the GCS metrics time series: per-deployment
+    QPS/p50/p99/errors plus node gauges; --watch refreshes in place."""
+    import time as _time
+
+    _connect(args)
+    from ray_tpu.util import state
+
+    rounds = args.count if args.watch else 1
+    i = 0
+    while rounds <= 0 or i < rounds:
+        samples = state.get_metrics_timeseries(limit=args.window)
+        if args.watch and sys.stdout.isatty():
+            print("\x1b[2J\x1b[H", end="")
+        print(render_metrics_snapshot(samples), end="", flush=True)
+        i += 1
+        if rounds <= 0 or i < rounds:
+            _time.sleep(args.interval)
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """Export the cluster's task-event timeline as Chrome-trace JSON
     (open in chrome://tracing or Perfetto)."""
@@ -209,6 +314,20 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("microbenchmark", help="core op/s microbenchmarks")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser(
+        "metrics", help="top-like SLO view (QPS/p50/p99/errors per "
+        "deployment, node gauges)",
+    )
+    p.add_argument("--address")
+    p.add_argument("--watch", action="store_true",
+                   help="refresh continuously")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--count", type=int, default=0,
+                   help="with --watch: stop after N refreshes (0 = forever)")
+    p.add_argument("--window", type=int, default=30,
+                   help="how many ring samples the rates/percentiles span")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("timeline", help="export Chrome-trace task timeline")
     p.add_argument("--address")

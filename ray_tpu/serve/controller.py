@@ -225,7 +225,8 @@ class ServeController:
         opts.setdefault("num_cpus", 1)
         opts.setdefault("max_concurrency", dep.max_ongoing_requests)
         actor_cls = ray_tpu.remote(**opts)(ServeReplica)
-        return actor_cls.remote(dep.func_or_class, dep.init_args, dep.init_kwargs)
+        return actor_cls.remote(dep.func_or_class, dep.init_args,
+                                dep.init_kwargs, deployment_name=dep.name)
 
     def _stop_replicas(self, actors):
         import ray_tpu

@@ -37,6 +37,62 @@ logger = logging.getLogger(__name__)
 # deployment doesn't set stream_backpressure_window
 DEFAULT_STREAM_BACKPRESSURE = 16
 
+# shared SLO latency buckets live with the metrics plane (re-exported here
+# for existing importers)
+from ray_tpu.util.metrics import LATENCY_MS_BOUNDS  # noqa: E402,F401
+
+
+class _ServeMetrics:
+    """Per-process serve SLO series (router side). One instance per process,
+    built on first use; every series is tagged by deployment so the
+    dashboard and `scripts metrics` read per-deployment QPS/latency."""
+
+    def __init__(self):
+        from ray_tpu.util import metrics as m
+
+        dep = ("deployment",)
+        self.e2e = m.Histogram(
+            "serve_request_latency_ms",
+            "end-to-end request latency observed at the router",
+            boundaries=LATENCY_MS_BOUNDS, tag_keys=dep,
+        )
+        self.queue = m.Histogram(
+            "serve_queue_wait_ms",
+            "request arrival -> dispatched to a replica (routing-table "
+            "refresh + waiting for live replicas + pick)",
+            boundaries=LATENCY_MS_BOUNDS, tag_keys=dep,
+        )
+        self.requests = m.Counter(
+            "serve_requests_total", "requests dispatched", tag_keys=dep,
+        )
+        self.errors = m.Counter(
+            "serve_request_errors_total",
+            "requests that surfaced an error to the caller", tag_keys=dep,
+        )
+        self.failovers = m.Counter(
+            "serve_failovers_total",
+            "dead-replica evictions observed by a router", tag_keys=dep,
+        )
+        self.inflight = m.Gauge(
+            "serve_replica_inflight",
+            "router-local in-flight requests across the deployment's "
+            "replicas", tag_keys=dep,
+        )
+
+
+_serve_metrics_inst: Optional[_ServeMetrics] = None
+
+
+def serve_metrics() -> Optional[_ServeMetrics]:
+    """The process's serve metric series, or None when the built-in
+    instrumentation is switched off (`metrics_enabled=False`)."""
+    global _serve_metrics_inst
+    if not _config.metrics_enabled:
+        return None
+    if _serve_metrics_inst is None:
+        _serve_metrics_inst = _ServeMetrics()
+    return _serve_metrics_inst
+
 
 class Router:
     def __init__(self, controller_handle):
@@ -126,19 +182,77 @@ class Router:
                 "serve.request", component="serve",
                 args={"deployment": deployment},
             )
-            ref, replica = self.assign_request_with_replica(
-                deployment, *args, **kwargs
-            )
+            sm = serve_metrics()
+            tags = {"deployment": deployment}
+            t0 = time.perf_counter()
+            if sm is not None:
+                # counted on ARRIVAL: a deployment with zero live replicas
+                # must still show QPS + errors (the outage is the point)
+                sm.requests.inc(1.0, tags)
+            try:
+                ref, replica = self.assign_request_with_replica(
+                    deployment, *args, **kwargs
+                )
+            except BaseException:
+                self._observe_error(sm, tags, t0)
+                raise
+            if sm is not None:
+                sm.queue.observe((time.perf_counter() - t0) * 1000, tags)
             deferred = (
                 _global_worker().backend.create_deferred()
                 if _config.serve_request_retries > 0 else None
             )
             if deferred is None:  # retries disabled / no deferred-ref support
+                self._observe_completion(sm, deployment, t0, ref)
                 return ref
             out_ref, fulfill = deferred
+            fulfill = self._timed_fulfill(sm, deployment, t0, fulfill)
             self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
                                attempt=0, trace_id=trace_id)
             return out_ref
+
+    # --------------------------------------------------------- SLO metrics
+    @staticmethod
+    def _observe_error(sm, tags: Dict[str, str], t0: float) -> None:
+        """Terminal request failure: the e2e histogram AND the error
+        counter record together (every error path shares this, so the
+        histogram count never drifts from requests/errors totals)."""
+        if sm is not None:
+            sm.e2e.observe((time.perf_counter() - t0) * 1000, tags)
+            sm.errors.inc(1.0, tags)
+
+    def _timed_fulfill(self, sm, deployment: str, t0: float, fulfill):
+        """Wrap a deferred-ref fulfill so the e2e latency histogram and the
+        error counter record exactly once, at the end of the retry chain."""
+        if sm is None:
+            return fulfill
+
+        def wrapped(**kw):
+            tags = {"deployment": deployment}
+            sm.e2e.observe((time.perf_counter() - t0) * 1000, tags)
+            if kw.get("error") is not None:
+                sm.errors.inc(1.0, tags)
+            fulfill(**kw)
+
+        return wrapped
+
+    def _observe_completion(self, sm, deployment: str, t0: float, ref):
+        """Non-deferred path: observe e2e/error when the ref settles."""
+        if sm is None:
+            return
+        tags = {"deployment": deployment}
+
+        def done(fut):
+            sm.e2e.observe((time.perf_counter() - t0) * 1000, tags)
+            try:
+                fut.result()
+            except BaseException:  # noqa: BLE001 - only classifying
+                sm.errors.inc(1.0, tags)
+
+        try:
+            ref.future().add_done_callback(done)
+        except Exception:  # noqa: BLE001 - backend without futures
+            pass
 
     # ------------------------------------------------------------- failover
     def _arm_failover(self, deployment, ref, replica, args, kwargs, fulfill,
@@ -232,6 +346,9 @@ class Router:
                     "serve: evicted dead replica of %r (%d left)",
                     deployment, len(kept),
                 )
+        sm = serve_metrics()
+        if sm is not None:
+            sm.failovers.inc(1.0, {"deployment": deployment})
         try:
             self._controller.report_dead_replica.remote(deployment, key)
         except Exception:  # noqa: BLE001 - controller reconcile still covers
@@ -250,23 +367,44 @@ class Router:
         kwargs = kwargs or {}
         timeout = timeout if timeout is not None else self.timeout_for(deployment)
         attempt = 0
+        sm = serve_metrics()
+        tags = {"deployment": deployment}
+        t0 = time.perf_counter()
         with tracing.ensure_trace():
             tracing.get_buffer().record_profile(
                 "serve.request", component="serve",
                 args={"deployment": deployment},
             )
+            if sm is not None:
+                sm.requests.inc(1.0, tags)
             while True:
-                ref, replica = self.assign_request_with_replica(
-                    deployment, *args, **kwargs
-                )
                 try:
-                    return ray_tpu.get(ref, timeout=timeout), replica
+                    ref, replica = self.assign_request_with_replica(
+                        deployment, *args, **kwargs
+                    )
+                except BaseException:
+                    # no live replicas: the outage must show as an error
+                    self._observe_error(sm, tags, t0)
+                    raise
+                if sm is not None and attempt == 0:
+                    sm.queue.observe((time.perf_counter() - t0) * 1000, tags)
+                try:
+                    out = ray_tpu.get(ref, timeout=timeout), replica
+                    if sm is not None:
+                        sm.e2e.observe(
+                            (time.perf_counter() - t0) * 1000, tags
+                        )
+                    return out
                 except (exc.ActorDiedError, exc.ActorUnavailableError):
                     self._on_replica_failure(deployment, replica)
                     attempt += 1
                     if attempt > _config.serve_request_retries:
+                        self._observe_error(sm, tags, t0)
                         raise
                     self.retry_count += 1
+                except BaseException:
+                    self._observe_error(sm, tags, t0)
+                    raise
 
     def wait_for_replicas(self, deployment: str, timeout: float = 30.0):
         """Block until the deployment has live replicas; returns the list
@@ -302,7 +440,14 @@ class Router:
                 )
             rkey = keys[idx]
             counts[rkey] = counts.get(rkey, 0) + 1
+            total = sum(counts.values())
+        self._set_inflight_gauge(deployment, total)
         return replicas[idx], rkey
+
+    def _set_inflight_gauge(self, deployment: str, total: int) -> None:
+        sm = serve_metrics()
+        if sm is not None:
+            sm.inflight.set(total, {"deployment": deployment})
 
     def assign_request_with_replica(self, deployment: str, *args, **kwargs):
         """Pick a replica and dispatch; returns (ObjectRef, replica handle)
@@ -337,13 +482,25 @@ class Router:
         if backpressure is None:
             backpressure = self.backpressure_for(deployment)
         attempt = 0
+        sm = serve_metrics()
+        tags = {"deployment": deployment}
+        t0 = time.perf_counter()
         with tracing.ensure_trace() as trace_id:
             tracing.get_buffer().record_profile(
                 "serve.stream", component="serve",
                 args={"deployment": deployment, "backpressure": backpressure},
             )
+            if sm is not None:
+                sm.requests.inc(1.0, tags)
             while True:
-                replica, rkey = self._pick_replica(deployment)
+                try:
+                    replica, rkey = self._pick_replica(deployment)
+                except BaseException:
+                    # no live replicas: the outage must show as an error
+                    self._observe_error(sm, tags, t0)
+                    raise
+                if sm is not None and attempt == 0:
+                    sm.queue.observe((time.perf_counter() - t0) * 1000, tags)
                 gen = replica.handle_request_streaming.options(
                     num_returns="streaming",
                     generator_backpressure_num_objects=backpressure,
@@ -351,16 +508,24 @@ class Router:
                 try:
                     header = ray_tpu.get(gen.next_ref(timeout), timeout=timeout)
                     self._dec_inflight(deployment, rkey)
+                    if sm is not None:
+                        # a stream's e2e is time-to-header: the dispatch +
+                        # first-byte SLO (chunks then flow push-based)
+                        sm.e2e.observe(
+                            (time.perf_counter() - t0) * 1000, tags
+                        )
                     return header, gen, replica
                 except (exc.ActorDiedError, exc.ActorUnavailableError):
                     self._dec_inflight(deployment, rkey)
                     self._on_replica_failure(deployment, replica)
                     attempt += 1
                     if attempt > _config.serve_request_retries:
+                        self._observe_error(sm, tags, t0)
                         raise
                     self.retry_count += 1
                 except BaseException:
                     self._dec_inflight(deployment, rkey)
+                    self._observe_error(sm, tags, t0)
                     raise
 
     def _dec_inflight(self, deployment: str, rkey: bytes) -> None:
@@ -368,6 +533,8 @@ class Router:
             counts = self._inflight.get(deployment)
             if counts and counts.get(rkey, 0) > 0:
                 counts[rkey] -= 1
+            total = sum(counts.values()) if counts else 0
+        self._set_inflight_gauge(deployment, total)
 
     def _track_completion(self, deployment: str, rkey: bytes, ref) -> None:
         def done(_):
@@ -375,6 +542,8 @@ class Router:
                 counts = self._inflight.get(deployment)
                 if counts and counts.get(rkey, 0) > 0:
                     counts[rkey] -= 1
+                total = sum(counts.values()) if counts else 0
+            self._set_inflight_gauge(deployment, total)
 
         try:
             ref.future().add_done_callback(done)

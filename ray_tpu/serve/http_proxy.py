@@ -12,10 +12,36 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from typing import Optional
 
 # sentinel distinguishing "stream ended" from a legitimate None chunk value
 _STREAM_END = object()
+
+_proxy_metrics = None
+
+
+def _proxy_m():
+    """Proxy-side SLO series, built lazily (config-gated)."""
+    from ray_tpu.core.config import _config
+
+    global _proxy_metrics
+    if not _config.metrics_enabled:
+        return None
+    if _proxy_metrics is None:
+        from ray_tpu.util import metrics as m
+        from ray_tpu.util.metrics import LATENCY_MS_BOUNDS
+
+        _proxy_metrics = (
+            m.Counter("serve_http_requests_total",
+                      "HTTP requests by route and status code",
+                      tag_keys=("route", "code")),
+            m.Histogram("serve_http_latency_ms",
+                        "HTTP dispatch latency at the proxy (to response "
+                        "or first streamed chunk)",
+                        boundaries=LATENCY_MS_BOUNDS, tag_keys=("route",)),
+        )
+    return _proxy_metrics
 
 
 class HTTPProxy:
@@ -114,8 +140,29 @@ class HTTPProxy:
                 pass
 
     def _dispatch(self, method: str, path: str, body: bytes):
+        t0 = time.perf_counter()
+        status, payload = self._dispatch_inner(method, path, body)
+        pm = _proxy_m()
+        if pm is not None:
+            # label cardinality is bounded by the ROUTING TABLE, never by
+            # client-supplied strings: unmatched paths (scanners, typos,
+            # query-string variants) all collapse into one bucket
+            route = path.split("?", 1)[0]
+            if route != "/-/healthz" and \
+                    self._router.deployment_for_route(route) is None:
+                route = "<unmatched>"
+            counter, hist = pm
+            code = "200" if status == "stream" else status.split()[0]
+            counter.inc(1.0, {"route": route, "code": code})
+            hist.observe((time.perf_counter() - t0) * 1000, {"route": route})
+        return status, payload
+
+    def _dispatch_inner(self, method: str, path: str, body: bytes):
         import ray_tpu
 
+        # route on the path alone: /route?x=1 serves the /route deployment
+        # (and the metrics label derives from the same stripped path)
+        path = path.split("?", 1)[0]
         if path == "/-/healthz":
             return "200 OK", {"status": "ok"}
         name = self._router.deployment_for_route(path)

@@ -39,14 +39,40 @@ def _resolve_bound(v):
     return v
 
 
+class _ReplicaMetrics:
+    """Replica-side SLO series: execution latency (the user callable's own
+    time, excluding routing and the wire) + live in-replica request gauge.
+    Recorded in the replica worker's registry, flushed to the GCS by the
+    worker's periodic metrics loop like any user metric."""
+
+    def __init__(self, deployment_name: str):
+        from ray_tpu.util import metrics as m
+        from ray_tpu.util.metrics import LATENCY_MS_BOUNDS
+
+        self.tags = {"deployment": deployment_name}
+        self.exec = m.Histogram(
+            "serve_exec_latency_ms",
+            "user-callable execution latency at the replica",
+            boundaries=LATENCY_MS_BOUNDS, tag_keys=("deployment",),
+        )
+        self.ongoing = m.Gauge(
+            "serve_replica_ongoing",
+            "requests executing in this replica right now",
+            tag_keys=("deployment",),
+        )
+
+
 class ServeReplica:
-    def __init__(self, func_or_class, init_args, init_kwargs):
+    def __init__(self, func_or_class, init_args, init_kwargs,
+                 deployment_name: str = ""):
         init_args = tuple(_resolve_bound(a) for a in init_args)
         init_kwargs = {k: _resolve_bound(v) for k, v in init_kwargs.items()}
         if inspect.isclass(func_or_class):
             self._callable = func_or_class(*init_args, **init_kwargs)
         else:
             self._callable = func_or_class
+        self._deployment_name = deployment_name
+        self._metrics: Any = None  # built lazily (config-gated)
         self._ongoing = 0
         self._total = 0
         self._streams: Dict[str, Tuple[Any, float]] = {}  # sid -> (gen, last_access)
@@ -62,6 +88,15 @@ class ServeReplica:
         # path issues ZERO per-chunk polling RPCs)
         self._legacy_polls = 0
 
+    def _m(self):
+        from ray_tpu.core.config import _config
+
+        if not _config.metrics_enabled or not self._deployment_name:
+            return None
+        if self._metrics is None:
+            self._metrics = _ReplicaMetrics(self._deployment_name)
+        return self._metrics
+
     def handle_request_streaming(self, *args, **kwargs):
         """Generator entry point for the push-based streaming path: called
         with ``num_returns="streaming"``, so every yield is pushed to the
@@ -73,6 +108,10 @@ class ServeReplica:
         that raised (streaming-generator error semantics)."""
         self._ongoing += 1
         self._total += 1
+        m = self._m()
+        t0 = time.perf_counter()
+        if m is not None:
+            m.ongoing.set(self._ongoing, m.tags)
         try:
             target = self._callable
             if not callable(target):
@@ -91,6 +130,9 @@ class ServeReplica:
                 yield result
         finally:
             self._ongoing -= 1
+            if m is not None:
+                m.exec.observe((time.perf_counter() - t0) * 1000, m.tags)
+                m.ongoing.set(self._ongoing, m.tags)
 
     def _reap_streams(self) -> None:
         now = time.monotonic()
@@ -119,6 +161,10 @@ class ServeReplica:
     def handle_request(self, *args, **kwargs) -> Any:
         self._ongoing += 1
         self._total += 1
+        m = self._m()
+        t0 = time.perf_counter()
+        if m is not None:
+            m.ongoing.set(self._ongoing, m.tags)
         try:
             target = self._callable
             if not callable(target):
@@ -137,6 +183,9 @@ class ServeReplica:
             return result
         finally:
             self._ongoing -= 1
+            if m is not None:
+                m.exec.observe((time.perf_counter() - t0) * 1000, m.tags)
+                m.ongoing.set(self._ongoing, m.tags)
 
     def next_chunk(self, sid: str) -> Dict[str, Any]:
         """Legacy polling path (compatibility fallback; new consumers use

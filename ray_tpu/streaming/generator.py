@@ -29,6 +29,27 @@ from ray_tpu.core.refs import ObjectRef
 _backlog_lock = threading.Lock()
 _backlogged: dict = {}
 _backlog_gauge = None
+_items_counter = None
+
+
+def _count_item() -> None:
+    """Owner-side item-throughput series: one count per pushed item landing
+    in this owner's store (both backends report through here). The
+    time-series rate of this counter is the streaming chunks/s the SLO
+    dashboard charts."""
+    from ray_tpu.core.config import _config
+
+    if not _config.metrics_enabled:
+        return
+    global _items_counter
+    if _items_counter is None:
+        from ray_tpu.util.metrics import Counter
+
+        _items_counter = Counter(
+            "streaming_items_total",
+            "stream items reported to this owner",
+        )
+    _items_counter.inc(1.0)
 
 
 def _update_backlog_gauge(state: "StreamState", buffered: int,
@@ -98,6 +119,7 @@ class StreamState:
                 self.count = index + 1
             buffered = self.count - self.consumed
             self._cond.notify_all()
+        _count_item()
         self._guard_owner_buffer(buffered)
 
     def _guard_owner_buffer(self, buffered: int) -> None:

@@ -30,12 +30,14 @@ from ray_tpu.tracing.events import (
     LIFECYCLE_STATES,
     TERMINAL_STATES,
     TaskEventBuffer,
+    current_job_id,
     current_task_id,
     current_trace_id,
     ensure_trace,
     get_buffer,
     new_trace_id,
     profile_span,
+    read_wal,
     task_context,
     trace_context,
 )
@@ -48,12 +50,14 @@ __all__ = [
     "TaskEventBuffer",
     "TaskEventAggregator",
     "build_chrome_trace",
+    "current_job_id",
     "current_task_id",
     "current_trace_id",
     "ensure_trace",
     "get_buffer",
     "new_trace_id",
     "profile_span",
+    "read_wal",
     "task_context",
     "trace_context",
 ]

@@ -26,22 +26,81 @@ def _terminal_state(states: List[str]) -> Optional[str]:
     return None
 
 
+_task_hists = None
+
+
+def _observe_task_duration(rec: dict, e: dict) -> None:
+    """Core task latency series, DERIVED at the aggregator from the
+    lifecycle events already flowing here — zero additional hot-path cost.
+    e2e (SUBMITTED -> terminal) pairs owner-side events, exec (RUNNING ->
+    EXECUTED) pairs worker-side events, so each delta stays on one process's
+    clock and is immune to cross-host skew."""
+    from ray_tpu.core.config import _config
+
+    if not _config.metrics_enabled:
+        return
+    global _task_hists
+    if _task_hists is None:
+        from ray_tpu.util import metrics as m
+
+        bounds = [1, 2, 5, 10, 25, 50, 100, 250, 500,
+                  1000, 2500, 5000, 10000, 30000, 60000]
+        _task_hists = (
+            m.Histogram("task_e2e_ms",
+                        "task submit -> terminal state (owner clock)",
+                        boundaries=bounds, tag_keys=("name",)),
+            m.Histogram("task_exec_ms",
+                        "task execution RUNNING -> EXECUTED (worker clock)",
+                        boundaries=bounds, tag_keys=("name",)),
+        )
+    e2e_hist, exec_hist = _task_hists
+    state = e.get("state")
+    tags = {"name": rec.get("name") or "<unnamed>"}
+    if state == ev.EXECUTED:
+        run = max(
+            (x["ts"] for x in rec["events"]
+             if x.get("state") == ev.RUNNING
+             and x.get("attempt", 0) == e.get("attempt", 0)
+             and x.get("ts", 0) <= e.get("ts", 0)),
+            default=None,
+        )
+        if run is not None:
+            exec_hist.observe((e["ts"] - run) * 1000, tags)
+    elif state in (ev.FINISHED, ev.FAILED):
+        sub = min(
+            (x["ts"] for x in rec["events"]
+             if x.get("state") == ev.SUBMITTED),
+            default=None,
+        )
+        if sub is not None:
+            e2e_hist.observe(max(0.0, e["ts"] - sub) * 1000, tags)
+
+
 class TaskEventAggregator:
     """Bounded store of per-task event timelines + free-floating spans."""
 
     def __init__(self, max_tasks: Optional[int] = None,
                  max_events_per_task: int = 256,
-                 max_profile_events: int = 20_000):
+                 max_profile_events: int = 20_000,
+                 max_tasks_per_job: Optional[int] = None):
         self._lock = threading.Lock()
         self._max_tasks = max_tasks or max(100, _config.task_events_max_tasks)
+        self._max_tasks_per_job = max_tasks_per_job or max(
+            10, _config.task_events_max_tasks_per_job
+        )
         self._max_events_per_task = max_events_per_task
-        # task_id -> {"task_id", "name", "actor_id", "events": [...]}
+        # task_id -> {"task_id", "name", "actor_id", "job_id", "events": []}
         self._tasks: "OrderedDict[str, dict]" = OrderedDict()
+        # per-job retention index: job_id -> OrderedDict[task_id, None] — a
+        # chatty job evicts its OWN oldest tasks before it can push another
+        # job's history out of the global window
+        self._job_tasks: Dict[str, "OrderedDict[str, None]"] = {}
         # spans with no task id (serve request spans, ad-hoc profile spans)
         self._profile: deque = deque(maxlen=max_profile_events)
         # drop accounting, surfaced as metrics
         self._dropped_at_source: Dict[str, int] = {}  # source -> cumulative
         self.evicted_tasks = 0
+        self.evicted_per_job: Dict[str, int] = {}
         self.truncated_events = 0
 
     # ------------------------------------------------------------- ingestion
@@ -52,20 +111,37 @@ class TaskEventAggregator:
                 # sources report a cumulative counter; max() is idempotent
                 prev = self._dropped_at_source.get(source, 0)
                 self._dropped_at_source[source] = max(prev, int(dropped))
+            # WAL recovery replays a dead worker's file; truncation races the
+            # kill (flush delivered, worker died before wal_flushed), so a
+            # replayed event may already be here. Per-process timestamps are
+            # strictly monotonic, making (state, ts, attempt) a reliable
+            # identity within one task — recovery is idempotent, duration
+            # histograms never double-observe.
+            dedup = source is not None and source.startswith("wal-")
             for e in events:
                 tid = e.get("task_id")
                 if tid is None:
                     self._profile.append(e)
                     continue
                 rec = self._tasks.get(tid)
+                if dedup and rec is not None:
+                    key = (e.get("state"), e.get("ts"), e.get("attempt", 0))
+                    if any(
+                        (x.get("state"), x.get("ts"), x.get("attempt", 0))
+                        == key
+                        for x in rec["events"]
+                    ):
+                        continue
                 if rec is None:
                     rec = self._tasks[tid] = {
                         "task_id": tid,
                         "name": e.get("name") or "",
                         "actor_id": e.get("actor_id"),
+                        "job_id": e.get("job_id"),
                         "events": [],
                         "profile_count": 0,
                     }
+                    self._index_job_locked(tid, rec)
                     self._evict_locked()
                 else:
                     self._tasks.move_to_end(tid)
@@ -73,6 +149,9 @@ class TaskEventAggregator:
                     rec["name"] = e["name"]
                 if rec.get("actor_id") is None and e.get("actor_id"):
                     rec["actor_id"] = e["actor_id"]
+                if rec.get("job_id") is None and e.get("job_id"):
+                    rec["job_id"] = e["job_id"]
+                    self._index_job_locked(tid, rec)
                 # the cap truncates PROFILE spans only: lifecycle events are
                 # intrinsically bounded (a handful per attempt) and dropping
                 # a terminal one would leave a phantom RUNNING state
@@ -82,10 +161,41 @@ class TaskEventAggregator:
                         continue
                     rec["profile_count"] += 1
                 rec["events"].append(e)
+                # WAL replays never drive the duration histograms: the
+                # record-level dedup above can't see tasks already evicted
+                # from retention, and a rare lost last-second observation
+                # beats ever double-counting the SLO series
+                if not dedup and e.get("state") in (
+                        ev.EXECUTED, ev.FINISHED, ev.FAILED):
+                    _observe_task_duration(rec, e)
+
+    def _index_job_locked(self, tid: str, rec: dict) -> None:
+        """Record tid under its job and enforce the per-job cap (evicting
+        the job's own oldest tasks; jobless events ride only the global
+        cap)."""
+        job = rec.get("job_id")
+        if job is None:
+            return
+        per = self._job_tasks.setdefault(job, OrderedDict())
+        per[tid] = None
+        while len(per) > self._max_tasks_per_job:
+            old_tid, _ = per.popitem(last=False)
+            if self._tasks.pop(old_tid, None) is not None:
+                self.evicted_tasks += 1
+                self.evicted_per_job[job] = (
+                    self.evicted_per_job.get(job, 0) + 1
+                )
 
     def _evict_locked(self) -> None:
         while len(self._tasks) > self._max_tasks:
-            self._tasks.popitem(last=False)
+            tid, rec = self._tasks.popitem(last=False)
+            job = rec.get("job_id")
+            if job is not None:
+                per = self._job_tasks.get(job)
+                if per is not None:
+                    per.pop(tid, None)
+                    if not per:
+                        del self._job_tasks[job]
             self.evicted_tasks += 1
 
     # --------------------------------------------------------------- queries
@@ -139,6 +249,7 @@ class TaskEventAggregator:
                 "total_tasks": len(self._tasks),
                 "dropped_at_source": sum(self._dropped_at_source.values()),
                 "evicted_tasks": self.evicted_tasks,
+                "evicted_per_job": dict(self.evicted_per_job),
                 "truncated_events": self.truncated_events,
             }
 

@@ -8,6 +8,8 @@ buffer of task state transitions, flushed to the GCS in batches, dropping
 from __future__ import annotations
 
 import contextlib
+import json
+import os
 import threading
 import time
 import uuid
@@ -16,6 +18,13 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import _config
+
+# compact WAL line encoder: separators + no circular check shave ~40% off
+# json.dumps on the per-event hot path; default=str keeps arbitrary span
+# args writable
+_WAL_ENCODE = json.JSONEncoder(
+    separators=(",", ":"), check_circular=False, default=str
+).encode
 
 # Typed lifecycle states, in causal order. Not every task visits every
 # state: LEASED fires only when the grant hits the raylet (cached-lease
@@ -53,22 +62,30 @@ def current_trace_id() -> Optional[str]:
     return getattr(_ctx, "trace_id", None)
 
 
+def current_job_id() -> Optional[str]:
+    return getattr(_ctx, "job_id", None)
+
+
 def new_trace_id() -> str:
     return uuid.uuid4().hex
 
 
 @contextlib.contextmanager
-def task_context(task_id: Optional[str], trace_id: Optional[str]):
-    """Execute a task frame: nested submissions see this task as parent and
-    ride the same trace."""
-    prev = (getattr(_ctx, "task_id", None), getattr(_ctx, "trace_id", None))
+def task_context(task_id: Optional[str], trace_id: Optional[str],
+                 job_id: Optional[str] = None):
+    """Execute a task frame: nested submissions see this task as parent,
+    ride the same trace, and inherit the job (per-job retention)."""
+    prev = (getattr(_ctx, "task_id", None), getattr(_ctx, "trace_id", None),
+            getattr(_ctx, "job_id", None))
     _ctx.task_id = task_id
     if trace_id is not None:
         _ctx.trace_id = trace_id
+    if job_id is not None:
+        _ctx.job_id = job_id
     try:
         yield
     finally:
-        _ctx.task_id, _ctx.trace_id = prev
+        _ctx.task_id, _ctx.trace_id, _ctx.job_id = prev
 
 
 @contextlib.contextmanager
@@ -135,6 +152,13 @@ class TaskEventBuffer:
         # THIS process, so the timeline renders them on the right row
         self._node_id: Optional[str] = None
         self._worker: Optional[str] = None
+        # crash forensics WAL: when enabled (workers), every recorded event
+        # is appended to a per-worker file BEFORE the periodic flush, so a
+        # SIGKILL loses at most the event being written — the raylet
+        # recovers the orphaned file into the aggregator (see
+        # node_manager._recover_worker_wal)
+        self._wal_path: Optional[str] = None
+        self._wal_fd: Optional[int] = None
 
     def set_identity(self, node_id: Optional[str],
                      worker: Optional[str]) -> None:
@@ -142,6 +166,81 @@ class TaskEventBuffer:
         the backend once its address is known)."""
         self._node_id = node_id
         self._worker = worker
+
+    # ------------------------------------------------------------------- WAL
+    def enable_wal(self, path: str) -> bool:
+        """Append every subsequent event to ``path`` (JSON lines). O_APPEND
+        writes of whole lines, no buffering: a torn final line at SIGKILL is
+        tolerated by the reader."""
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        except OSError:
+            return False
+        with self._lock:
+            self._wal_path = path
+            self._wal_fd = fd
+        return True
+
+    def _wal_append_locked(self, e: dict) -> None:
+        if self._wal_fd is None:
+            return
+        try:
+            # None fields are dropped: readers use .get(), and smaller
+            # lines keep the per-event cost down on the worker hot path
+            os.write(self._wal_fd, (_WAL_ENCODE(
+                {k: v for k, v in e.items() if v is not None}
+            ) + "\n").encode())
+        except OSError:
+            # a full/st-gone disk must never break the hot path; drop the
+            # WAL, the in-memory plane keeps working
+            try:
+                os.close(self._wal_fd)
+            except OSError:
+                pass
+            self._wal_fd = None
+
+    def wal_flushed(self) -> None:
+        """The flush loop delivered a drain to the aggregator: shrink the
+        WAL to exactly the still-unflushed events. Empty buffer (the common
+        case — a flush usually drains everything) truncates in place; a
+        non-empty buffer REWRITES the file from the in-memory events (an
+        atomic tmp+rename, re-opened for appends), so a busy worker's WAL
+        never grows past one buffer and crash recovery never replays events
+        the aggregator already has."""
+        with self._lock:
+            if self._wal_fd is None:
+                return
+            try:
+                if not self._events:
+                    os.ftruncate(self._wal_fd, 0)
+                    return
+                tmp = self._wal_path + ".tmp"
+                data = "".join(
+                    _WAL_ENCODE(
+                        {k: v for k, v in e.items() if v is not None}
+                    ) + "\n"
+                    for e in self._events
+                ).encode()
+                fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                             0o644)
+                try:
+                    os.write(fd, data)
+                finally:
+                    os.close(fd)
+                os.replace(tmp, self._wal_path)
+                # clear BEFORE close/reopen: if either fails, the stale
+                # (closed) descriptor number must never be written again —
+                # the OS reuses fd numbers, and a later append would
+                # corrupt whatever file/socket inherited it
+                fd_old, self._wal_fd = self._wal_fd, None
+                os.close(fd_old)
+                self._wal_fd = os.open(
+                    self._wal_path, os.O_WRONLY | os.O_APPEND
+                )
+            except OSError:
+                # a failed shrink only costs WAL compactness, never events
+                pass
 
     # ------------------------------------------------------------- recording
     def enabled(self) -> bool:
@@ -166,6 +265,7 @@ class TaskEventBuffer:
         node_id: Optional[str] = None,
         worker: Optional[str] = None,
         trace_id: Optional[str] = None,
+        job_id: Optional[str] = None,
         component: str = "core",
         dur: Optional[float] = None,
         args: Optional[dict] = None,
@@ -176,6 +276,8 @@ class TaskEventBuffer:
             return False
         if not _sampled(trace_id, task_id):
             return False
+        if job_id is None:
+            job_id = current_job_id()
         with self._lock:
             if len(self._events) >= self._capacity:
                 self._dropped += 1
@@ -191,6 +293,7 @@ class TaskEventBuffer:
                 "node_id": node_id if node_id is not None else self._node_id,
                 "worker": worker if worker is not None else self._worker,
                 "trace_id": trace_id,
+                "job_id": job_id,
                 "component": component,
             }
             if dur is not None:
@@ -198,6 +301,7 @@ class TaskEventBuffer:
             if args:
                 e["args"] = args
             self._events.append(e)
+            self._wal_append_locked(e)
         return True
 
     def record_profile(self, name: str, dur: Optional[float] = None,
@@ -261,16 +365,25 @@ async def flush_task_events_loop(buf: TaskEventBuffer, get_conn,
 
     ``get_conn`` returns the CURRENT GCS connection (reconnect loops swap
     it) or None; ``use_notify`` sends one-way frames for callers that must
-    not block on the reply (the raylet)."""
+    not block on the reply (the raylet).
+
+    Drops are reported relative to this loop's START: the buffer is
+    process-global and long-lived (a pytest driver outlives many clusters),
+    and a fresh GCS must not be told about overflow that happened before it
+    existed — ``dropped_at_source`` means "dropped during this cluster's
+    lifetime". The reported value stays cumulative and monotonic, so the
+    aggregator's per-source max() idempotence is unchanged."""
     import asyncio
 
     from ray_tpu.core import rpc
 
     period = max(_config.task_events_flush_interval_ms, 100) / 1000
+    baseline = buf.dropped
     last_dropped = 0
     while True:
         await asyncio.sleep(period)
-        events, dropped = buf.drain()
+        events, raw_dropped = buf.drain()
+        dropped = max(0, raw_dropped - baseline)
         if not events and dropped == last_dropped:
             continue
         conn = get_conn()
@@ -283,9 +396,33 @@ async def flush_task_events_loop(buf: TaskEventBuffer, get_conn,
             await send("report_task_events", events=events, dropped=dropped,
                        source=source)
             last_dropped = dropped
+            # flushed events are aggregated: the crash-forensics WAL only
+            # needs to keep the unflushed tail
+            buf.wal_flushed()
         except (rpc.RpcError, rpc.ConnectionLost):
             if events:
                 buf.note_dropped(len(events))
+
+
+def read_wal(path: str) -> List[dict]:
+    """Parse a worker's WAL file (JSON lines). Tolerates the torn final
+    line a SIGKILL mid-write leaves behind; returns [] for a missing or
+    empty file."""
+    import json
+
+    out: List[dict] = []
+    try:
+        with open(path, "rb") as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue  # torn tail (or garbage): skip, keep the rest
+                if isinstance(e, dict):
+                    out.append(e)
+    except OSError:
+        return []
+    return out
 
 
 @contextlib.contextmanager

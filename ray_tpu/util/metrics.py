@@ -31,6 +31,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 _TagTuple = Tuple[Tuple[str, str], ...]
 
+# shared latency bucket boundaries (ms) for the built-in SLO histograms
+# (serve router/replica/proxy, raylet lease grants, cgraph execute): sub-ms
+# dispatch through multi-second model calls. One list so a bucket tweak
+# lands everywhere at once.
+LATENCY_MS_BOUNDS = [1, 2, 5, 10, 25, 50, 100, 250, 500,
+                     1000, 2500, 5000, 10000, 30000]
+
 
 def _tags_key(tags: Optional[Dict[str, str]]) -> _TagTuple:
     return tuple(sorted((tags or {}).items()))
@@ -218,8 +225,23 @@ def merge_snapshots(per_source: Dict[str, Tuple[float, List[dict]]],
     return list(merged.values())
 
 
+def _escape_tag_value(v: str) -> str:
+    """Prometheus text exposition label-value escaping: backslash, double
+    quote and newline must be escaped or the line (and every line after it)
+    is unparseable."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping: backslash and newline (quotes are legal here)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_tags(tags: _TagTuple, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in tags] + ([extra] if extra else [])
+    parts = [f'{k}="{_escape_tag_value(v)}"' for k, v in tags]
+    parts += [extra] if extra else []
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
@@ -231,7 +253,7 @@ def render_prometheus(series_list: List[dict]) -> str:
         ptype = {"counter": "counter", "gauge": "gauge",
                  "histogram": "histogram"}[kind]
         if s.get("description"):
-            out.append(f"# HELP {name} {s['description']}")
+            out.append(f"# HELP {name} {_escape_help(s['description'])}")
         out.append(f"# TYPE {name} {ptype}")
         for tags, val in sorted(s["points"].items()):
             if kind == "histogram":
@@ -252,3 +274,144 @@ def render_prometheus(series_list: List[dict]) -> str:
             else:
                 out.append(f"{name}{_fmt_tags(tags)} {val}")
     return "\n".join(out) + "\n"
+
+
+# ----------------------------------------------------------------------- #
+# Time series: bounded ring of merged snapshots (SLO observability)
+# ----------------------------------------------------------------------- #
+
+class MetricsTimeSeries:
+    """Bounded ring of merged metric snapshots, sampled on a fixed period.
+
+    The GCS samples its cluster-wide merge every
+    ``metrics_report_interval_ms`` (the local backend samples its in-process
+    registry the same way), so "what was p99 five minutes ago" is answerable
+    from ``depth`` points of history instead of only the latest snapshot.
+    Each sample is ``{"ts": float, "series": [merged series snapshots]}``.
+    """
+
+    def __init__(self, depth: Optional[int] = None):
+        from collections import deque
+
+        from ray_tpu.core.config import _config
+
+        self.depth = max(2, depth or _config.metrics_timeseries_depth)
+        self._lock = threading.Lock()
+        self._ring: "deque" = deque(maxlen=self.depth)
+
+    def sample(self, series_list: List[dict], ts: Optional[float] = None):
+        with self._lock:
+            self._ring.append({"ts": ts or time.time(),
+                               "series": series_list})
+
+    def query(self, names: Optional[Sequence[str]] = None,
+              limit: Optional[int] = None) -> List[dict]:
+        """Newest-last window of samples; ``names`` filters series."""
+        with self._lock:
+            samples = list(self._ring)
+        if limit is not None:
+            limit = int(limit)
+            samples = samples[-limit:] if limit > 0 else []
+        if names is None:
+            return samples
+        keep = set(names)
+        return [
+            {"ts": s["ts"],
+             "series": [x for x in s["series"] if x["name"] in keep]}
+            for s in samples
+        ]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+def _find_points(sample: dict, name: str,
+                 tags: Optional[Dict[str, str]] = None):
+    """(series, summed/selected point) for one sample, or (None, None).
+    Counter/gauge points sum over every tag combination that is a superset
+    of ``tags``; histogram points sum bucket-wise the same way."""
+    for s in sample.get("series", ()):
+        if s["name"] != name:
+            continue
+        want = set((tags or {}).items())
+        acc = None
+        for ptags, val in s["points"].items():
+            if not want <= set(ptags):
+                continue
+            if isinstance(val, list):
+                acc = list(val) if acc is None else [
+                    a + b for a, b in zip(acc, val)
+                ]
+            else:
+                acc = val if acc is None else acc + val
+        return s, acc
+    return None, None
+
+
+def counter_rate(samples: List[dict], name: str,
+                 tags: Optional[Dict[str, str]] = None) -> Optional[float]:
+    """Per-second rate of a cumulative counter over the sample window
+    (first→last), or None when fewer than two samples carry the series.
+    Robust to counter resets (a restart): negative deltas clamp to 0."""
+    seen = []
+    for s in samples:
+        _, v = _find_points(s, name, tags)
+        if v is not None:
+            seen.append((s["ts"], v))
+    if len(seen) < 2:
+        return None
+    (t0, v0), (t1, v1) = seen[0], seen[-1]
+    if t1 <= t0:
+        return None
+    return max(0.0, v1 - v0) / (t1 - t0)
+
+
+def histogram_percentile(boundaries: Sequence[float], counts: Sequence[float],
+                         q: float) -> Optional[float]:
+    """Estimate the q-th percentile (q in [0,1]) from per-bucket counts
+    (NON-cumulative, the registry's internal layout: one count per boundary
+    plus the +Inf bucket). Linear interpolation inside the winning bucket,
+    prometheus histogram_quantile style; the +Inf bucket reports the last
+    finite boundary."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, b in enumerate(boundaries):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            frac = 0.0 if counts[i] == 0 else (rank - prev) / counts[i]
+            return lo + (b - lo) * frac
+        lo = b
+    return boundaries[-1] if boundaries else None
+
+
+def window_percentile(samples: List[dict], name: str, q: float,
+                      tags: Optional[Dict[str, str]] = None,
+                      ) -> Optional[float]:
+    """Percentile of a histogram series OVER the sample window: the bucket
+    deltas between the window's first and last samples (what happened in the
+    window), falling back to the cumulative last sample when the series only
+    appears once."""
+    seen = []
+    boundaries = None
+    for s in samples:
+        series, v = _find_points(s, name, tags)
+        if v is not None:
+            boundaries = series.get("boundaries") or boundaries
+            seen.append(v)
+    if not seen or boundaries is None:
+        return None
+    last = seen[-1]
+    nb = len(boundaries) + 1  # + the +Inf bucket; tail is [sum, count]
+    counts = list(last[:nb])
+    if len(seen) > 1:
+        first = seen[0]
+        delta = [max(0.0, a - b) for a, b in zip(counts, first[:nb])]
+        if sum(delta) > 0:
+            counts = delta
+    return histogram_percentile(boundaries, counts, q)

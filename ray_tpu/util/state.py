@@ -114,12 +114,51 @@ def list_objects() -> List[Dict[str, Any]]:
 def summarize_metrics() -> Dict[str, Any]:
     """Cluster-level counters (nodes, actors, task states), plus this
     process's RPC wire counters (`rpc_frames_sent`, `rpc_bytes_sent`,
-    `rpc_frames_coalesced`, `rpc_oob_bytes`, ...) — the dispatch plane
-    lives in the calling driver, so its coalescing/zero-copy telemetry is
-    reported from here, not the GCS."""
+    `rpc_frames_coalesced`, `rpc_oob_bytes`, ...). The same rpc_* names are
+    ALSO registered as real registry Counters in every process's metrics
+    flush loop, so the cluster-wide sums live in `/metrics` and
+    `get_metrics_timeseries`; this merge keeps the calling driver's own
+    totals visible even before its first flush."""
     from ray_tpu.core import rpc
 
     m = _gcs_call("get_metrics")
     if isinstance(m, dict):
         m.update(rpc.stats_snapshot())
     return m
+
+
+# ------------------------------------------------------- metrics time series
+def get_metrics_timeseries(names: Optional[List[str]] = None,
+                           limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Bounded history of cluster-wide merged metric snapshots, one sample
+    per ``metrics_report_interval_ms`` (``[{"ts", "series"}...]``, newest
+    last). Backed by the GCS ring in cluster mode and an in-process ring in
+    local mode — the retention layer behind "what was p99 five minutes
+    ago"."""
+    return _gcs_call("get_metrics_timeseries", names=names, limit=limit)
+
+
+def metric_rate(name: str, tags: Optional[Dict[str, str]] = None,
+                samples: Optional[List[dict]] = None,
+                window: Optional[int] = None) -> Optional[float]:
+    """Per-second rate of a cumulative Counter over the sampled window
+    (e.g. serve QPS from ``serve_requests_total``)."""
+    from ray_tpu.util.metrics import counter_rate
+
+    if samples is None:
+        samples = get_metrics_timeseries(names=[name], limit=window)
+    return counter_rate(samples, name, tags)
+
+
+def metric_percentile(name: str, q: float,
+                      tags: Optional[Dict[str, str]] = None,
+                      samples: Optional[List[dict]] = None,
+                      window: Optional[int] = None) -> Optional[float]:
+    """q-th percentile (q in [0,1]) of a Histogram over the sampled window
+    (bucket deltas first→last sample; e.g. p99 serve latency from
+    ``serve_request_latency_ms``)."""
+    from ray_tpu.util.metrics import window_percentile
+
+    if samples is None:
+        samples = get_metrics_timeseries(names=[name], limit=window)
+    return window_percentile(samples, name, q, tags)
