@@ -25,18 +25,29 @@ per-call task submission, no control-plane round trips, and up to
     finally:
         compiled.teardown()
 
+Cross-node graphs: at materialize time the planner resolves each edge's
+endpoints to their nodes; edges that span nodes get a ``NetChannel`` — the
+peer-to-peer stream transport plane (``core/transport/``: persistent
+token-authenticated connections, seq-framed slots, ``max_in_flight`` mapped
+to transport credits, large payloads landing zero-copy in the destination
+node's shm dir) — so a compiled pipeline's stages can live on different
+hosts with the same SPSC semantics as the shm ring.
+
 Fault tolerance: the compiled graph subscribes to its participants' actor
 state, so a dead participant raises ``ActorDiedError`` from
-``execute()``/``ref.get()`` promptly instead of timing out on a dead ring.
+``execute()``/``ref.get()`` promptly instead of timing out on a dead ring;
+a severed cross-node channel raises ``ChannelSeveredError`` the same way.
 When every participant was created with ``max_restarts != 0``, the graph is
 recoverable: ``compiled.recover()`` (or ``experimental_compile(...,
 auto_recover=True)``) waits out the restarts, re-allocates channels on a
-fresh epoch, re-installs the loops, and resumes at the next seq — in-flight
-executions fail with a precise per-seq error.
+fresh epoch (re-reading placement, so cross-node channels re-materialize
+exactly like shm ones), re-installs the loops, and resumes at the next seq
+— in-flight executions fail with a precise per-seq error.
 """
 
 from ray_tpu.cgraph.channel import (
     ChannelClosedError,
+    ChannelSeveredError,
     ChannelTimeoutError,
     IntraProcessChannel,
     ShmChannel,
@@ -47,6 +58,7 @@ from ray_tpu.cgraph.compiled_dag import (
     actor_in_compiled_graph,
     compile_dag,
 )
+from ray_tpu.cgraph.net_channel import NetChannel
 
 __all__ = [
     "CompiledDAG",
@@ -54,7 +66,9 @@ __all__ = [
     "compile_dag",
     "actor_in_compiled_graph",
     "ChannelClosedError",
+    "ChannelSeveredError",
     "ChannelTimeoutError",
     "IntraProcessChannel",
+    "NetChannel",
     "ShmChannel",
 ]

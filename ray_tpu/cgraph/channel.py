@@ -57,41 +57,25 @@ class ChannelClosedError(exc.RayTpuError):
     """The channel was closed (teardown or peer death) while blocked on it."""
 
 
+class ChannelSeveredError(exc.RayTpuError):
+    """A cross-node channel's transport connection was lost while the
+    channel was OPEN (network cut, peer process death, auth/seq failure) —
+    distinct from ChannelClosedError (graceful teardown). The graph is
+    recoverable: ``dag.recover()`` / ``auto_recover=True`` re-materializes
+    every channel slot on fresh connections and resumes at the next seq."""
+
+
 class ChannelTimeoutError(exc.GetTimeoutError):
     """A channel read/write did not complete within the timeout."""
 
 
-# buffers at least this large are written into the ring as out-of-band
-# segments (straight from their source memory) and, when the reader opts in
-# to zero-copy, mapped back as read-only views over the mmap
-_OOB_MIN = 1 << 12
-
-
-def _dumps_oob(obj: Any):
-    """Pickle ``obj`` splitting large buffers out-of-band.
-
-    Returns ``(payload, bufs)``: the in-band pickle stream plus the raw
-    source buffers (numpy data, bytes) to be written directly into the
-    ring after it — the write path never concatenates them."""
-    bufs = []
-
-    def cb(pb: pickle.PickleBuffer):
-        try:
-            raw = pb.raw()
-        except BufferError:  # non-contiguous: keep in-band
-            return True
-        if raw.nbytes < _OOB_MIN:
-            return True
-        bufs.append(raw)
-        return False
-
-    try:
-        return pickle.dumps(obj, protocol=5, buffer_callback=cb), bufs
-    except Exception:  # noqa: BLE001 - closures, local classes
-        del bufs[:]
-        import cloudpickle
-
-        return cloudpickle.dumps(obj, protocol=5, buffer_callback=cb), bufs
+# pickle splitter shared with the cross-node stream transport: buffers at
+# least OOB_MIN large are written out-of-band straight from their source
+# memory (ring segments here, sendmsg chunks there) and, when the reader
+# opts in to zero-copy, mapped back as read-only views
+from ray_tpu.core.transport.stream import (  # noqa: E402
+    dumps_oob as _dumps_oob,
+)
 
 
 class _Backoff:

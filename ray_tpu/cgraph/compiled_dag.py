@@ -50,10 +50,12 @@ from ray_tpu import exceptions as exc_mod
 from ray_tpu.cgraph import executor as ex
 from ray_tpu.cgraph.channel import (
     ChannelClosedError,
+    ChannelSeveredError,
     ChannelTimeoutError,
     IntraProcessChannel,
     ShmChannel,
 )
+from ray_tpu.cgraph.net_channel import NetChannel
 from ray_tpu.core.config import _config
 from ray_tpu.dag import (
     ClassMethodNode,
@@ -66,6 +68,7 @@ from ray_tpu.dag import (
 )
 
 _TICK = object()  # accessor marking a pacing-only input channel
+_DRIVER = "driver"  # channel-endpoint owner sentinel for the driver process
 
 # live graphs, torn down by ray_tpu.shutdown(): execution loops block inside
 # channel reads on non-daemon actor threads, so leaked graphs would hang
@@ -150,7 +153,8 @@ class CompiledDAGRef:
             return self._value
         try:
             self._value = self._dag._get_result(self._seq, timeout)
-        except (ChannelTimeoutError, exc_mod.ActorUnavailableError):
+        except (ChannelTimeoutError, ChannelSeveredError,
+                exc_mod.ActorUnavailableError):
             raise  # retryable: in flight, or resumable after dag.recover()
         except BaseException as e:
             self._error = e
@@ -262,8 +266,15 @@ class CompiledDAG:
         self._num_slots = 0
         self._input_slots: List[Tuple[Any, int]] = []   # (accessor, slot)
         self._output_slots: List[int] = []              # driver idx -> slot
+        # per-slot endpoint owners ("driver" or a _Loop): read at every
+        # materialize to choose shm vs cross-node stream transport per edge
+        self._slot_writer: Dict[int, Any] = {}
+        self._slot_reader: Dict[int, Any] = {}
         self._channels: List[Any] = []
         self._fn_actors: List[Any] = []
+        # a cross-node channel's transport was lost (reason string); like
+        # participant failures, cleared by recover()'s re-materialize
+        self._severed: Optional[str] = None
         # participant fault tracking (fed by the backend's actor listener)
         self._participants: Dict[bytes, Any] = {}       # id bytes -> handle
         self._failed: Dict[bytes, str] = {}             # id bytes -> reason
@@ -305,8 +316,27 @@ class CompiledDAG:
         self._num_slots += 1
         return slot
 
-    def _make_channel(self, slot: int):
+    def _make_channel(self, slot: int, placement: Optional[Dict[int, str]]):
         if self._core is not None:
+            if placement is not None:
+                w = placement[self._slot_writer.get(slot, _DRIVER)]
+                r = placement[self._slot_reader.get(slot, _DRIVER)]
+                if w != r:
+                    # endpoints on different nodes: a shm ring cannot span
+                    # hosts — this edge rides the stream transport plane
+                    import secrets
+
+                    ch = NetChannel(
+                        channel_id=(
+                            f"{self._graph_id}-e{self._epoch}-s{slot}"
+                        ),
+                        token=secrets.token_hex(16),
+                        session=self._core.session,
+                        max_msgs=self.max_in_flight,
+                        reader_node=r, writer_node=w,
+                    )
+                    self._channels.append(ch)
+                    return ch
             import os
 
             from ray_tpu.core.object_store import shm_store
@@ -326,6 +356,55 @@ class CompiledDAG:
             ch = IntraProcessChannel(max_msgs=self.max_in_flight)
         self._channels.append(ch)
         return ch
+
+    def _resolve_placement(self) -> Optional[Dict[Any, str]]:
+        """Map every channel-endpoint owner (each loop + the driver) to its
+        CURRENT node, or None when everything provably shares one node
+        (local mode, single-node cluster — the common case pays nothing).
+        Called at every materialize, so a recovery epoch re-reads placement
+        and re-plans shm vs net per edge."""
+        if self._core is None:
+            return None
+        try:
+            # REGISTERED nodes, not momentarily-healthy ones: a loaded
+            # raylet missing a health check must not collapse a multi-node
+            # cluster into the single-node shm shortcut (the per-actor
+            # resolution below reads assigned placement, which is correct
+            # regardless of transient health)
+            known = {n.get("NodeID") for n in self._backend.nodes()}
+        except Exception:  # noqa: BLE001 - control-plane blip: resolve per
+            known = None     # actor below rather than guessing single-node
+        if known is not None and len(known) <= 1:
+            return None
+        placement: Dict[Any, str] = {_DRIVER: self._core.node_id}
+        for loop in getattr(self, "_loops", []):
+            aid = loop.handle._actor_id
+            node = self._backend.actor_node(aid)
+            if node is None:
+                # not scheduled yet: placement IS the channel plan, so wait
+                # for it (compile-time only; restarts re-enter via recover)
+                self._backend.wait_actor_alive(
+                    aid, _config.transport_connect_timeout_s
+                )
+                for _ in range(5):
+                    node = self._backend.actor_node(aid)
+                    if node is not None:
+                        break
+                    import time as _time
+
+                    _time.sleep(0.2)  # GCS blip: actor_node returns None
+            if node is None:
+                # NEVER guess (falling back to the driver's node would plan
+                # a shm ring a remote worker cannot open): fail typed, the
+                # caller retries compile/recover once the control plane is
+                # reachable again
+                raise exc_mod.ActorUnavailableError(
+                    f"cannot resolve node placement for participant "
+                    f"{aid.hex()[:16]} (control plane unreachable?); "
+                    "retry compile/recover"
+                )
+            placement[loop] = node
+        return placement
 
     # ------------------------------------------------------------ compile
     def _compile(self, dag: DAGNode):
@@ -422,14 +501,17 @@ class CompiledDAG:
                     return (ex.SRC_LOCAL, keys[id(dep)])
                 key = ("node", id(dep), id(consumer_loop))
                 idx = consumer_loop.in_slot(
-                    key, lambda: self._edge_slot(dep, key)
+                    key,
+                    lambda: self._edge_slot(
+                        dep, key, producer_loop, consumer_loop
+                    ),
                 )
                 return (ex.SRC_CHAN, idx)
             if isinstance(dep, (InputNode, InputAttributeNode)):
                 accessor = dep._key if isinstance(dep, InputAttributeNode) else None
                 key = ("input", id(dep), id(consumer_loop))
                 idx = consumer_loop.in_slot(
-                    key, lambda: self._input_slot(accessor)
+                    key, lambda: self._input_slot(accessor, consumer_loop)
                 )
                 return (ex.SRC_CHAN, idx)
             if isinstance(dep, ClassNode):
@@ -471,6 +553,8 @@ class CompiledDAG:
             didx = self._output_chan_of.get(id(o))
             if didx is None:
                 slot = self._new_slot()
+                self._slot_writer[slot] = loop_of[id(o)]
+                self._slot_reader[slot] = _DRIVER
                 didx = len(self._output_slots)
                 self._output_slots.append(slot)
                 self._output_chan_of[id(o)] = didx
@@ -483,7 +567,7 @@ class CompiledDAG:
         # or a source loop would free-run ahead of execute() calls
         for loop in loops.values():
             if not loop.in_slots:
-                loop.in_slots.append(self._input_slot(_TICK))
+                loop.in_slots.append(self._input_slot(_TICK, loop))
 
         # 6) materialize the slots into channels and install the loops
         self._loops = list(loops.values())
@@ -493,14 +577,18 @@ class CompiledDAG:
         }
         self._materialize()
 
-    def _edge_slot(self, producer, key) -> int:
+    def _edge_slot(self, producer, key, producer_loop, consumer_loop) -> int:
         slot = self._new_slot()
         self._pending_out[key] = (producer, slot)
+        self._slot_writer[slot] = producer_loop
+        self._slot_reader[slot] = consumer_loop
         return slot
 
-    def _input_slot(self, accessor) -> int:
+    def _input_slot(self, accessor, reader_loop) -> int:
         slot = self._new_slot()
         self._input_slots.append((accessor, slot))
+        self._slot_writer[slot] = _DRIVER
+        self._slot_reader[slot] = reader_loop
         return slot
 
     def _materialize(self):
@@ -509,7 +597,13 @@ class CompiledDAG:
         long-lived actor task each). Called at compile time and again by
         recover()."""
         self._channels = []
-        chans = [self._make_channel(s) for s in range(self._num_slots)]
+        # placement read HERE, not at compile: a recovery epoch re-reads it,
+        # so restarted participants that moved nodes re-plan their edges'
+        # transport (shm ↔ net) exactly like the slots' first materialize
+        placement = self._resolve_placement()
+        chans = [
+            self._make_channel(s, placement) for s in range(self._num_slots)
+        ]
         self._input_channels = [(acc, chans[s]) for acc, s in self._input_slots]
         self._output_channels = [chans[s] for s in self._output_slots]
         if _config.cgraph_zero_copy_reads:
@@ -519,6 +613,12 @@ class CompiledDAG:
             # execute() drains through the same output channel.
             for ch in self._output_channels:
                 ch.zero_copy_reads = True
+        # the driver is the reader of every output slot: bind + advertise
+        # cross-node endpoints BEFORE the loops start writing results
+        for ch in self._output_channels:
+            prepare = getattr(ch, "prepare_reader", None)
+            if prepare is not None:
+                prepare()
         for loop in self._loops:
             loop.in_channels = [chans[s] for s in loop.in_slots]
             loop.out_channels = [chans[s] for s in loop.out_slots]
@@ -562,14 +662,32 @@ class CompiledDAG:
                 "dag.recover() to re-establish channels and resume"
             )
 
+    def _on_channel_severed(self, reason: str):
+        """A cross-node channel's transport died under a live graph: mark
+        it (recover() re-materializes every slot) and surface either the
+        transparent auto-recover retry or the typed, actionable error."""
+        self._severed = reason or "channel severed"
+        if self.auto_recover:
+            raise _RecoverNeeded()
+        raise ChannelSeveredError(
+            f"cross-node compiled-graph channel severed ({self._severed}); "
+            "call dag.recover() to re-materialize the channels and resume"
+        )
+
     def _probe_failure(self):
         """A blocked execute()/get() slice expired: distinguish 'still in
         flight' from 'the graph is dead' — participant state first (pushed,
-        so it is prompt), then the loop tasks themselves."""
+        so it is prompt), then the loop tasks themselves. Scans ALL loops
+        before concluding 'exited early': under a severed cross-node
+        channel some loops exit cleanly (cascaded closes) while the loop
+        that observed the sever carries the typed, classifiable error."""
         if self._failure_event.is_set():
             self._classify_failure()
+        if self._severed:
+            self._on_channel_severed(self._severed)
         import ray_tpu
 
+        exited_early = False
         for loop in self._loops:
             ready, _ = ray_tpu.wait([loop.ref], timeout=0)
             if not ready:
@@ -585,9 +703,13 @@ class CompiledDAG:
                     )
                     self._failure_event.set()
                     self._classify_failure()
+                if isinstance(e, ChannelSeveredError):
+                    self._on_channel_severed(str(e))
                 raise RuntimeError(
                     "compiled graph execution loop died"
                 ) from e
+            exited_early = True
+        if exited_early:
             raise RuntimeError(
                 "a compiled graph execution loop exited early "
                 "(actor torn down?)"
@@ -670,6 +792,19 @@ class CompiledDAG:
                             break
                         except ChannelTimeoutError:
                             self._probe_failure()
+                        except ChannelSeveredError as e:
+                            # the partially-written seq dies with the old
+                            # channels; recover() re-materializes them
+                            # empty, so no misalignment to mark
+                            self._on_channel_severed(str(e))
+                        except ChannelClosedError as e:
+                            if self._torn_down:
+                                raise  # teardown race, not a failure
+                            # a remote loop's exit-close beat our probe:
+                            # classify the underlying failure if its report
+                            # landed, else the close IS the sever signal
+                            self._probe_failure()
+                            self._on_channel_severed(str(e))
                     wrote += 1
             except _RecoverNeeded:
                 # the partially-written seq dies with the old channels —
@@ -698,6 +833,8 @@ class CompiledDAG:
             raise RuntimeError("compiled graph was torn down")
         if self._failure_event.is_set():
             self._classify_failure()
+        if self._severed:
+            self._on_channel_severed(self._severed)
         if self._broken:
             raise RuntimeError(self._broken)
 
@@ -788,6 +925,19 @@ class CompiledDAG:
                     self._drain_one_result(step)
                 except ChannelTimeoutError:
                     self._probe_failure()
+                except ChannelSeveredError as e:
+                    self._on_channel_severed(str(e))
+                except ChannelClosedError as e:
+                    if self._torn_down:
+                        raise
+                    # a closed output channel under a LIVE graph means a
+                    # loop exited on us: classify the precise failure if
+                    # its report already landed (actor death, sever) —
+                    # otherwise the close itself is the sever signal (the
+                    # peer's in-band close can race ahead of the loop-task
+                    # failure report)
+                    self._probe_failure()
+                    self._on_channel_severed(str(e))
             # moved onto the CompiledDAGRef by get(); keeping consumed
             # entries here would leak for the lifetime of a hot pipeline
             entry = self._results.pop(seq, None)
@@ -834,14 +984,15 @@ class CompiledDAG:
         with self._exec_lock, self._read_lock:
             if self._torn_down:
                 raise RuntimeError("compiled graph was torn down")
-            if not self._failed:
+            if not self._failed and not self._severed:
                 return self
             # 0) salvage results already sitting in the output rings: a seq
             # that completed before the failure must not be reported lost
             try:
                 while self._next_result_seq < self._submitted:
                     self._drain_one_result(0.05)
-            except (ChannelTimeoutError, ChannelClosedError):
+            except (ChannelTimeoutError, ChannelClosedError,
+                    ChannelSeveredError):
                 pass
             deadline = _time.monotonic() + timeout
             # 1) every participant must come back ALIVE (DEAD → raise)
@@ -877,7 +1028,12 @@ class CompiledDAG:
                 except Exception:  # noqa: BLE001
                     pass
             # 3) fail the in-flight seqs with a precise per-seq error
-            reasons = ", ".join(sorted(set(self._failed.values()))) or "?"
+            reasons = ", ".join(
+                sorted(
+                    set(self._failed.values())
+                    | ({self._severed} if self._severed else set())
+                )
+            ) or "?"
             for seq in range(self._next_result_seq, self._submitted):
                 if seq not in self._results:
                     self._results[seq] = exc_mod.ActorDiedError(
@@ -890,6 +1046,7 @@ class CompiledDAG:
             self._partial_entry = []
             self._next_result_seq = self._submitted
             self._broken = None
+            self._severed = None
             self._failed.clear()
             self._failure_event.clear()
             # 4) fresh epoch: new channels, new loops, same plan

@@ -23,7 +23,11 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu.cgraph.channel import ChannelClosedError, ChannelTimeoutError
+from ray_tpu.cgraph.channel import (
+    ChannelClosedError,
+    ChannelSeveredError,
+    ChannelTimeoutError,
+)
 
 # input-source encodings for ExecNode.args / .kwargs
 SRC_CHAN = "chan"      # ("chan", in_channel_index)
@@ -94,35 +98,69 @@ def node_loop(instance, nodes: List[ExecNode], in_channels: List[Any],
     pacing = [i for i in range(len(in_channels)) if i not in consumed]
     loop_key = ",".join(n.method_name or "<fn>" for n in nodes)
     iterations = 0
-    while True:
+    graceful_exit = True
+    try:
+        # cross-node channels: bind this loop as reader of its inbound
+        # stream edges NOW (advertising the endpoints), so upstream writers
+        # connect regardless of when each channel's first lazy read happens
         try:
-            # chaos injection point "cgraph.iter": kill this participant at
-            # the Nth loop iteration (cluster: real SIGKILL of the worker;
-            # local mode: the backend fails the actor and ChaosKilled unwinds
-            # this thread) — the deterministic mid-pipeline death the
-            # compiled-graph recovery tests drive.
-            act = chaos.fire("cgraph.iter", key=loop_key)
-            if act is not None and act.get("action") == "kill":
-                chaos.perform_kill_self(f"cgraph chaos kill ({loop_key})")
-            msgs: Dict[int, Tuple[str, Any]] = {}
-            stopping = False
-            for i in pacing:
-                msgs[i] = in_channels[i].read()
-                if msgs[i][0] == STOP:
-                    stopping = True
-            stopping = _run_iteration(
-                instance, nodes, in_channels, out_channels, msgs, stopping
-            )
+            for ch in in_channels:
+                prepare = getattr(ch, "prepare_reader", None)
+                if prepare is not None:
+                    prepare()
         except ChannelClosedError:
+            # the graph was torn down before this loop started (close
+            # tombstone in the endpoint registry): exit cleanly
             return iterations
-        if stopping:
-            return iterations
-        if iterations % _TRACE_STRIDE == 0 and _trace_buf.enabled():
-            _trace_buf.record_profile(
-                "cgraph.loop", component="cgraph",
-                args={"loop": loop_key, "iteration": iterations},
-            )
-        iterations += 1
+        while True:
+            try:
+                # chaos injection point "cgraph.iter": kill this participant
+                # at the Nth loop iteration (cluster: real SIGKILL of the
+                # worker; local mode: the backend fails the actor and
+                # ChaosKilled unwinds this thread) — the deterministic
+                # mid-pipeline death the compiled-graph recovery tests drive.
+                act = chaos.fire("cgraph.iter", key=loop_key)
+                if act is not None and act.get("action") == "kill":
+                    chaos.perform_kill_self(f"cgraph chaos kill ({loop_key})")
+                msgs: Dict[int, Tuple[str, Any]] = {}
+                stopping = False
+                for i in pacing:
+                    msgs[i] = in_channels[i].read()
+                    if msgs[i][0] == STOP:
+                        stopping = True
+                stopping = _run_iteration(
+                    instance, nodes, in_channels, out_channels, msgs, stopping
+                )
+            except ChannelClosedError:
+                return iterations
+            if stopping:
+                return iterations
+            if iterations % _TRACE_STRIDE == 0 and _trace_buf.enabled():
+                _trace_buf.record_profile(
+                    "cgraph.loop", component="cgraph",
+                    args={"loop": loop_key, "iteration": iterations},
+                )
+            iterations += 1
+    except ChannelSeveredError:
+        graceful_exit = False
+        raise  # fails the loop task typed; the driver's probes classify it
+    finally:
+        # stream channels have no shared-memory close flag a peer can poll:
+        # closing them here cascades teardown to loops blocked on edges
+        # this one will never serve again. A GRACEFUL exit (stop sentinel,
+        # teardown close) sends CLOSE frames; a loop dying of a sever
+        # severs its other channels ABRUPTLY instead — a graceful CLOSE
+        # could race ahead of the loop-failure report and read as an
+        # orderly teardown at the driver.
+        for ch in list(in_channels) + list(out_channels):
+            if getattr(ch, "close_on_loop_exit", False):
+                try:
+                    if graceful_exit:
+                        ch.close()
+                    else:
+                        ch.sever_local()
+                except Exception:  # noqa: BLE001 - best-effort cascade
+                    pass
 
 
 def _run_iteration(instance, nodes, in_channels, out_channels, msgs,
@@ -169,8 +207,9 @@ def _run_iteration(instance, nodes, in_channels, out_channels, msgs,
         for idx in node.out_channels:
             try:
                 out_channels[idx].write(result)
-            except (ChannelClosedError, ChannelTimeoutError):
-                raise  # teardown / backpressure: not a result error
+            except (ChannelClosedError, ChannelSeveredError,
+                    ChannelTimeoutError):
+                raise  # teardown / sever / backpressure: not a result error
             except Exception as e:  # noqa: BLE001 - oversized OR unpicklable
                 # result: the seq slot must still be filled (as an ERR that
                 # surfaces at ref.get()) or the graph misaligns — and the

@@ -1,0 +1,385 @@
+"""NetChannel: a compiled-graph channel whose endpoints live on different
+nodes, carried by the peer-to-peer stream transport (core/transport/).
+
+Same SPSC blocking interface as ``ShmChannel`` — ``write``/``read``/
+``close``/``unlink``, bounded by ``max_msgs`` undelivered messages — chosen
+by the compiled-dag planner whenever an edge's endpoints resolve to
+different nodes at materialize time (placement is re-read every recovery
+epoch, so ``dag.recover()`` re-materializes cross-node channels exactly
+like shm ones).
+
+Roles bind lazily to whichever process touches which end: the first
+``read()`` (or an explicit ``prepare_reader()``, which the execution loops
+call at startup) registers with the process's stream listener and
+advertises ``(node, host, port)`` under the channel id in the GCS endpoint
+registry; the first ``write()`` resolves that endpoint (a blocking,
+event-driven GCS wait — no polling tick) and dials it with the session
+token plus the per-channel token minted at materialize time.
+
+Flow control: the channel's ``max_msgs`` (= the graph's ``max_in_flight``)
+becomes the stream's credit window — a writer blocks once that many
+messages are unconsumed, end to end across the wire. Large payload buffers
+ride the transport's out-of-band path: written from source memory, landed
+in the destination node's shm dir, readable zero-copy when the driver opts
+in (``zero_copy_reads``, same view-lifetime rule as the shm ring: valid
+until the next read on the channel).
+
+Failure model: a lost connection WITHOUT a graceful close raises
+``ChannelSeveredError`` (recover re-materializes); a peer's close raises
+``ChannelClosedError`` after buffered messages drain. Chaos point
+``channel.send`` severs the Nth write's connection deterministically
+(``chaos.plan(seed).sever_channel(...)``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.cgraph.channel import (
+    ChannelClosedError,
+    ChannelSeveredError,
+    ChannelTimeoutError,
+)
+from ray_tpu.core.config import _config
+from ray_tpu.core.transport import stream as _tr
+from ray_tpu.testing import chaos as _chaos
+
+_bytes_sent = None
+_credit_stall = None
+
+
+def _observe_send(nbytes: int, stall_s: float) -> None:
+    """channel_bytes_sent / channel_credit_stall_ms — the cross-node data
+    plane's two SLO series (throughput and backpressure), lazily created
+    and gated like every built-in instrument."""
+    global _bytes_sent, _credit_stall
+    if not _config.metrics_enabled:
+        return
+    from ray_tpu.util import metrics as m
+
+    if _bytes_sent is None:
+        _bytes_sent = m.Counter(
+            "channel_bytes_sent",
+            description="bytes sent over cross-node compiled-graph "
+                        "stream channels",
+        )
+        _credit_stall = m.Counter(
+            "channel_credit_stall_ms",
+            description="time channel writers spent blocked on transport "
+                        "credits (max_in_flight backpressure)",
+        )
+    _bytes_sent.inc(nbytes)
+    if stall_s > 0:
+        _credit_stall.inc(stall_s * 1000.0)
+
+
+def _core():
+    from ray_tpu.api import _global_worker
+
+    core = getattr(_global_worker().backend, "core", None)
+    if core is None:
+        raise ChannelSeveredError(
+            "NetChannel needs the cluster runtime (no CoreWorker in this "
+            "process)"
+        )
+    return core
+
+
+class NetChannel:
+    """Cross-node SPSC channel over one authenticated stream connection."""
+
+    # execution loops close their net channels when they exit, cascading
+    # teardown through peers that have no shared-memory close flag to poll
+    close_on_loop_exit = True
+
+    def __init__(self, channel_id: Optional[str] = None,
+                 token: Optional[str] = None, session: str = "",
+                 max_msgs: int = 16, reader_node: str = "?",
+                 writer_node: str = "?"):
+        self.channel_id = channel_id or f"nc-{uuid.uuid4().hex[:16]}"
+        self.token = token or uuid.uuid4().hex
+        self.session = session
+        self.max_msgs = max(1, int(max_msgs))
+        self.reader_node = reader_node
+        self.writer_node = writer_node
+        self.zero_copy_reads = False
+        self._local_closed = False
+        self._reader: Optional[_tr.ReaderState] = None
+        self._writer: Optional[_tr.WriterState] = None
+        self._attach_started: Optional[float] = None
+
+    # ------------------------------------------------------------- pickling
+    def __reduce__(self):
+        return (
+            NetChannel._restore,
+            ((self.channel_id, self.token, self.session, self.max_msgs,
+              self.reader_node, self.writer_node),),
+        )
+
+    @staticmethod
+    def _restore(desc) -> "NetChannel":
+        cid, token, session, max_msgs, rn, wn = desc
+        return NetChannel(channel_id=cid, token=token, session=session,
+                          max_msgs=max_msgs, reader_node=rn, writer_node=wn)
+
+    def __repr__(self):
+        role = (
+            "reader" if self._reader is not None
+            else "writer" if self._writer is not None else "unbound"
+        )
+        return (
+            f"NetChannel({self.channel_id}, {self.writer_node}->"
+            f"{self.reader_node}, {role}, closed={self.closed})"
+        )
+
+    # ------------------------------------------------------------ reader side
+    def _spool_dir(self) -> str:
+        from ray_tpu.core.object_store import shm_store
+
+        return os.path.join(
+            shm_store.session_dir(self.session or _core().session),
+            "cgraph_net",
+        )
+
+    def prepare_reader(self) -> None:
+        """Bind this process as the channel's reader NOW: register with the
+        stream listener and advertise the endpoint in the GCS registry
+        (execution loops call this at startup so writers never wait on a
+        loop's read order; idempotent)."""
+        if self._reader is not None or self._local_closed:
+            return
+        core = _core()
+        # a close tombstone means the graph was torn down before this loop
+        # started: exit promptly instead of advertising into a dead channel
+        try:
+            entry = core.io.run(
+                core._gcs_call_retrying(
+                    "get_channel_endpoint", channel_id=self.channel_id,
+                    wait_timeout=0.0, attempts=3,
+                )
+            )
+        except Exception:  # noqa: BLE001 - registration below still guards
+            entry = None
+        if entry is not None and entry.get("closed"):
+            self._local_closed = True
+            raise ChannelClosedError(
+                f"channel {self.channel_id} closed before this reader "
+                "attached (graph torn down)"
+            )
+        reader = _tr.ReaderState(
+            self.channel_id, self.token, self.max_msgs, self._spool_dir()
+        )
+        host, port = _tr.get_listener().register(reader)
+        if host == "127.0.0.1" and _config.transport_bind_host in ("0.0.0.0",
+                                                                   ""):
+            # bind-all with no explicit advertise host: advertise the host
+            # peers already reach this node's raylet on (config.py's
+            # documented fallback) instead of loopback
+            raylet_addr = getattr(core, "raylet_address", None)
+            if raylet_addr:
+                host = raylet_addr.rsplit(":", 1)[0]
+        self._reader = reader
+        core.io.run(
+            core._gcs_call_retrying(
+                "register_channel_endpoint",
+                channel_id=self.channel_id,
+                endpoint={"host": host, "port": port, "node": core.node_id},
+                owner=f"{core.node_id}:{os.getpid()}",
+            )
+        )
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        if self._reader is None:
+            if self._local_closed:
+                raise ChannelClosedError(
+                    f"channel {self.channel_id} closed"
+                )
+            self.prepare_reader()
+        try:
+            return self._reader.recv_obj(
+                timeout=timeout, zero_copy=self.zero_copy_reads
+            )
+        except (_tr.TransportError, _tr.StreamTimeoutError) as e:
+            raise _map_transport_error(self.channel_id, e) from e
+
+    # ------------------------------------------------------------ writer side
+    def _ensure_writer(self, timeout: Optional[float]) -> _tr.WriterState:
+        if self._writer is not None:
+            return self._writer
+        core = _core()
+        now = time.monotonic()
+        if self._attach_started is None:
+            self._attach_started = now
+        total_deadline = (
+            self._attach_started + _config.transport_connect_timeout_s
+        )
+        call_deadline = total_deadline if timeout is None else \
+            min(total_deadline, now + timeout)
+        while True:
+            remaining = call_deadline - time.monotonic()
+            if remaining <= 0:
+                if time.monotonic() >= total_deadline:
+                    raise ChannelSeveredError(
+                        f"channel {self.channel_id}: reader endpoint never "
+                        f"advertised within "
+                        f"{_config.transport_connect_timeout_s:.0f}s "
+                        f"(reader node {self.reader_node})"
+                    )
+                raise ChannelTimeoutError(
+                    f"channel {self.channel_id} write timed out resolving "
+                    "the reader endpoint"
+                )
+            try:
+                entry = core.io.run(
+                    core._gcs_call_retrying(
+                        "get_channel_endpoint",
+                        channel_id=self.channel_id,
+                        wait_timeout=min(remaining, 5.0),
+                        timeout=min(remaining, 5.0) + 10,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - GCS outage
+                raise ChannelSeveredError(
+                    f"channel {self.channel_id}: endpoint lookup failed "
+                    f"({e})"
+                ) from e
+            if entry is None:
+                continue  # event-driven wait expired; re-check deadlines
+            if entry.get("closed"):
+                raise ChannelClosedError(
+                    f"channel {self.channel_id} closed"
+                )
+            if "dropped" in entry:
+                raise ChannelSeveredError(
+                    f"channel {self.channel_id}: reader endpoint dropped "
+                    f"({entry['dropped']})"
+                )
+            ep = entry["endpoint"]
+            try:
+                self._writer = _tr.connect_writer(
+                    ep["host"], ep["port"], self.channel_id, self.token,
+                    timeout=max(1.0, remaining),
+                )
+            except (_tr.TransportError, _tr.StreamTimeoutError) as e:
+                raise _map_transport_error(self.channel_id, e) from e
+            return self._writer
+
+    def write(self, obj: Any, timeout: Optional[float] = None) -> None:
+        if self._local_closed:
+            raise ChannelClosedError(f"channel {self.channel_id} closed")
+        act = _chaos.fire("channel.send", key=self.channel_id)
+        if act is not None:
+            if act.get("action") == "sever":
+                if self._writer is not None:
+                    self._writer.sever("chaos: channel severed")
+                raise ChannelSeveredError(
+                    f"channel {self.channel_id} severed (chaos injection)"
+                )
+            if act.get("action") == "delay":
+                time.sleep(act.get("delay_s") or 0.1)
+        w = self._ensure_writer(timeout)
+        try:
+            nbytes, stall = w.send_obj(obj, timeout=timeout)
+        except (_tr.TransportError, _tr.StreamTimeoutError) as e:
+            raise _map_transport_error(self.channel_id, e) from e
+        _observe_send(nbytes, stall)
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def closed(self) -> bool:
+        if self._local_closed:
+            return True
+        if self._reader is not None and self._reader.closed:
+            return True
+        return self._writer is not None and self._writer.closed
+
+    def close(self) -> None:
+        """Graceful close of whichever end this process holds: the peer
+        observes ChannelClosedError (after draining buffered messages). The
+        endpoint entry becomes a 'closed' tombstone so late parties — a
+        writer mid-resolve, a reader whose loop starts after teardown —
+        observe the close instead of joining a dead channel. A process
+        holding NEITHER end (the driver, for a never-executed input edge)
+        dials the advertised reader once to deliver the CLOSE in-band;
+        actor-to-actor edges otherwise cascade through the loops'
+        exit-closes."""
+        already = self._local_closed
+        self._local_closed = True
+        reader, self._reader = self._reader, None
+        writer, self._writer = self._writer, None
+        if reader is not None:
+            try:
+                _tr.get_listener().deregister(self.channel_id)
+            except Exception:  # noqa: BLE001
+                pass
+            reader.close()
+            self._tombstone()
+            return
+        if writer is not None:
+            writer.close()
+            return
+        if already:
+            return
+        # unattached close: reach the remote reader (if any) in-band, then
+        # tombstone the registry for anyone not yet attached
+        try:
+            core = _core()
+            entry = core.io.run(
+                core._gcs_call_retrying(
+                    "get_channel_endpoint", channel_id=self.channel_id,
+                    wait_timeout=0.0, attempts=1,
+                )
+            )
+            if entry and not entry.get("closed") and "dropped" not in entry:
+                ep = entry["endpoint"]
+                w = _tr.connect_writer(
+                    ep["host"], ep["port"], self.channel_id, self.token,
+                    timeout=2.0,
+                )
+                w.close()
+        except Exception:  # noqa: BLE001 - best-effort teardown signal
+            pass
+        self._tombstone()
+
+    def sever_local(self, reason: str = "peer loop severed") -> None:
+        """Abrupt close of whichever end this process holds — NO graceful
+        CLOSE frames. A loop that dies of a sever uses this on its other
+        channels so every peer observes a typed ChannelSeveredError (a
+        graceful CLOSE here could race ahead of the loop-failure report
+        and read as an orderly teardown at the driver)."""
+        self._local_closed = True
+        reader, self._reader = self._reader, None
+        writer, self._writer = self._writer, None
+        if reader is not None:
+            try:
+                _tr.get_listener().deregister(self.channel_id)
+            except Exception:  # noqa: BLE001
+                pass
+            reader.sever(reason)
+        if writer is not None:
+            writer.sever(reason)
+
+    def _tombstone(self) -> None:
+        try:
+            core = _core()
+            core.io.run(
+                core._gcs_call_retrying(
+                    "close_channel", channel_id=self.channel_id, attempts=1,
+                )
+            )
+        except Exception:  # noqa: BLE001 - shutdown path
+            pass
+
+    def unlink(self) -> None:
+        self.close()
+
+
+def _map_transport_error(channel_id: str, e: Exception) -> Exception:
+    if isinstance(e, _tr.StreamClosedError):
+        return ChannelClosedError(f"channel {channel_id} closed ({e})")
+    if isinstance(e, _tr.StreamTimeoutError):
+        return ChannelTimeoutError(str(e))
+    return ChannelSeveredError(f"channel {channel_id} severed: {e}")

@@ -116,6 +116,12 @@ class Backend(abc.ABC):
         """Block until the actor is ALIVE. Raises ActorDiedError when it is
         (or becomes) DEAD, GetTimeoutError on timeout."""
 
+    def actor_node(self, actor_id: ActorID) -> Optional[str]:
+        """Node id the actor currently runs on, or None when unknown (the
+        compiled-graph planner reads this at materialize time to choose shm
+        vs cross-node stream channels per edge)."""
+        return None
+
     def add_actor_listener(self, cb) -> None:
         """Subscribe ``cb(actor_id_bytes, state, reason)`` to actor lifecycle
         transitions (compiled graphs watch their participants through this)."""

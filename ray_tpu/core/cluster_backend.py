@@ -351,6 +351,17 @@ class ClusterBackend(Backend):
             if info["state"] == "ALIVE":
                 return
 
+    def actor_node(self, actor_id) -> Optional[str]:
+        try:
+            info = self.core.io.run(
+                self.core._gcs_call_retrying(
+                    "get_actor", actor_id=actor_id.binary(), timeout=30
+                )
+            )
+        except (rpc.RpcError, rpc.ConnectionLost):
+            return None
+        return None if info is None else info.get("node_id")
+
     def add_actor_listener(self, cb) -> None:
         self.core.add_actor_listener(cb)
 

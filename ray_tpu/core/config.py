@@ -89,6 +89,20 @@ class Config:
     # channel); set False to always copy out
     cgraph_zero_copy_reads: bool = True
 
+    # --- cross-node stream transport (core/transport, cgraph NetChannel) ----
+    # host the per-process stream listener binds AND advertises; set
+    # 0.0.0.0 (bind-all) plus transport_advertise_host for real multi-host
+    transport_bind_host: str = "127.0.0.1"
+    # host peers dial; empty = the bind host (or the node's raylet host
+    # when binding 0.0.0.0)
+    transport_advertise_host: str = ""
+    # how long a channel writer waits for the reader's endpoint to appear
+    # in the GCS registry + for the TCP connect/handshake
+    transport_connect_timeout_s: float = 30.0
+    # guard on a single blocking socket send/recv: a peer stalled longer
+    # than this severs the stream (typed error, never a silent hang)
+    transport_io_timeout_s: float = 120.0
+
     # --- timeouts / health --------------------------------------------------
     health_check_period_ms: int = 1_000
     health_check_failure_threshold: int = 5

@@ -145,6 +145,13 @@ class GcsServer:
         self.timeseries = MetricsTimeSeries()
         self._store_dirty = True  # durable-table mutation since last snapshot
         self._actor_events: Dict[bytes, asyncio.Event] = {}  # get_actor waits
+        # cross-node stream-channel endpoint registry (core/transport/):
+        # a channel reader advertises (host, port, node) here at materialize
+        # time; the writer blocks in get_channel_endpoint until it appears.
+        # In-memory only — channel ids are epoch-scoped, a restarted GCS
+        # simply sees fresh registrations from the next materialize.
+        self.channel_endpoints: Dict[str, dict] = {}
+        self._endpoint_events: Dict[str, asyncio.Event] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -432,6 +439,101 @@ class GcsServer:
 
     def handle_kv_keys(self, conn, ns, prefix=""):
         return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    # ------------------------------------- stream-channel endpoint registry
+    def handle_register_channel_endpoint(self, conn, channel_id: str,
+                                         endpoint: dict, owner: str = ""):
+        """A channel reader advertises where its stream listener accepts
+        (``{"host", "port", "node"}``). ``owner`` identifies the advertising
+        worker (``<node_id>:<pid>``) so the raylet's worker-death path can
+        tombstone a dead reader's endpoints and waiting writers fail fast
+        typed instead of dialing a ghost."""
+        self._bound_endpoint_registry()
+        self.channel_endpoints[channel_id] = {
+            "endpoint": endpoint, "owner": owner,
+        }
+        ev = self._endpoint_events.pop(channel_id, None)
+        if ev is not None:
+            ev.set()
+        return True
+
+    async def handle_get_channel_endpoint(self, conn, channel_id: str,
+                                          wait_timeout: float = 0.0):
+        """Resolve a channel's advertised endpoint; with ``wait_timeout``
+        the call blocks (event-driven, no polling tick) until the reader
+        registers. Returns the registry entry — a tombstoned entry carries
+        ``"dropped"`` with the reason — or None on timeout. The per-id wait
+        event is reclaimed when the LAST waiter gives up, so ids that never
+        register (severed epochs) don't accumulate entries forever."""
+        deadline = time.monotonic() + max(0.0, wait_timeout)
+        while True:
+            entry = self.channel_endpoints.get(channel_id)
+            if entry is not None:
+                return entry
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            ev = self._endpoint_events.get(channel_id)
+            if ev is None:
+                ev = self._endpoint_events[channel_id] = asyncio.Event()
+                ev.waiters = 0
+            ev.waiters += 1
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return None
+            finally:
+                ev.waiters -= 1
+                if ev.waiters <= 0 and not ev.is_set():
+                    self._endpoint_events.pop(channel_id, None)
+
+    def handle_remove_channel_endpoint(self, conn, channel_id: str):
+        self.channel_endpoints.pop(channel_id, None)
+        return True
+
+    def _bound_endpoint_registry(self) -> None:
+        """The registry is volatile + epoch-scoped; bound leaks from
+        readers that died without a reaper. Spent entries (close
+        tombstones, dropped owners) are evicted first — a LIVE graph's
+        endpoint only goes when the registry is full of live entries,
+        which is the caller holding 8k+ concurrent channels."""
+        if len(self.channel_endpoints) <= 8192:
+            return
+        spent = [
+            k for k, e in self.channel_endpoints.items()
+            if e.get("closed") or "dropped" in e
+        ]
+        victims = (spent + [k for k in self.channel_endpoints
+                            if k not in set(spent)])[:1024]
+        for k in victims:
+            del self.channel_endpoints[k]
+
+    def handle_close_channel(self, conn, channel_id: str):
+        """Graceful close marker: late parties (a reader's loop that starts
+        after the driver tore the graph down, a writer resolving the
+        endpoint) observe 'closed' instead of registering/dialing into a
+        dead channel. Kept as a tombstone in the bounded registry."""
+        self._bound_endpoint_registry()
+        self.channel_endpoints[channel_id] = {"closed": True, "owner": ""}
+        ev = self._endpoint_events.pop(channel_id, None)
+        if ev is not None:
+            ev.set()
+        return True
+
+    def handle_drop_channel_endpoints(self, conn, owner: str,
+                                      reason: str = ""):
+        """Raylet worker-death path: tombstone every endpoint the dead
+        worker advertised, waking blocked writers with a typed 'dropped'
+        answer instead of leaving them to burn their connect timeout."""
+        n = 0
+        for cid, entry in self.channel_endpoints.items():
+            if entry.get("owner") == owner and "dropped" not in entry:
+                entry["dropped"] = reason or "owner worker died"
+                ev = self._endpoint_events.pop(cid, None)
+                if ev is not None:
+                    ev.set()
+                n += 1
+        return n
 
     # ---------------------------------------------------------- functions
     def handle_register_function(self, conn, fn_id, blob):

@@ -191,6 +191,11 @@ class LocalBackend(Backend):
             return "DEAD"
         return actor.state
 
+    def actor_node(self, actor_id: ActorID) -> str:
+        # local mode is one process: every edge is intra-host by definition,
+        # so the cgraph planner never picks a cross-node stream channel
+        return "local"
+
     def wait_actor_alive(self, actor_id: ActorID, timeout: float) -> None:
         deadline = time.monotonic() + timeout
         while True:

@@ -743,6 +743,17 @@ class Raylet:
 
     async def _on_worker_death(self, handle: WorkerHandle):
         await self._recover_worker_wal(handle)
+        # tombstone any cross-node channel endpoints the dead worker
+        # advertised: writers blocked in get_channel_endpoint fail fast
+        # typed instead of dialing a ghost until their connect timeout
+        try:
+            await self.gcs.call(
+                "drop_channel_endpoints",
+                owner=f"{self.node_id}:{handle.proc.pid}",
+                reason=f"worker process died (exit {handle.proc.returncode})",
+            )
+        except (rpc.RpcError, rpc.ConnectionLost):
+            pass
         if handle.lease_id:
             self.handle_return_lease(None, handle.lease_id)
         if handle.actor_id is not None:

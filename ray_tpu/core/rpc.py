@@ -191,6 +191,29 @@ def encode_frame_bytes(msg) -> bytes:
     )
 
 
+def advance_chunks(chunks: List[Any], sent: int) -> List[Any]:
+    """Drop ``sent`` bytes from the front of a chunk list — the resume point
+    after a partial gather-write. The partially-written chunk comes back as
+    a memoryview sliced at the exact byte offset, so a retry continues
+    mid-frame without duplicating or skipping bytes (frame-boundary
+    integrity under partial ``sendmsg``/``writev``)."""
+    for i, c in enumerate(chunks):
+        mv = c if isinstance(c, memoryview) else memoryview(c)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        n = mv.nbytes
+        if sent >= n:
+            sent -= n
+            continue
+        rest = [mv[sent:] if sent else mv]
+        rest.extend(chunks[i + 1:])
+        return rest
+    return []
+
+
+_IOV_CAP = 1024  # conservative IOV_MAX bound for one sendmsg call
+
+
 def _decode_body(body) -> Any:
     """Parse a v2 frame body. Out-of-band buffers come back as zero-copy
     memoryviews over ``body`` (numpy arrays reconstruct over them)."""
@@ -554,6 +577,40 @@ class Connection:
             if not fut.done():
                 fut.set_result(None)
 
+    @staticmethod
+    def _send_vectored(writer: asyncio.StreamWriter, chunks: List[Any]):
+        """Write as much of ``chunks`` as the kernel will take with vectored
+        ``socket.sendmsg`` calls (one syscall per gather instead of one
+        transport ``write()`` copy per chunk); returns the unsent remainder
+        for the transport fallback. Only runs while the transport's write
+        buffer is EMPTY — bytes queued there must reach the wire first, so
+        a partial flush falls back instead of reordering."""
+        sock = writer.get_extra_info("socket")
+        transport = getattr(writer, "transport", None)
+        # asyncio hands back a TransportSocket wrapper: its sendmsg is
+        # deprecated on 3.10 and REMOVED on 3.11+, so operate on the raw
+        # socket underneath — falling back to the transport write path
+        # whenever no usable raw socket is exposed
+        sock = getattr(sock, "_sock", sock)
+        if (sock is None or transport is None
+                or not hasattr(sock, "sendmsg")):
+            return chunks
+        while chunks:
+            try:
+                if transport.get_write_buffer_size() > 0:
+                    return chunks
+            except (AttributeError, RuntimeError):
+                return chunks
+            try:
+                sent = sock.sendmsg(chunks[:_IOV_CAP] if len(chunks) > _IOV_CAP
+                                    else chunks)
+            except (BlockingIOError, InterruptedError):
+                return chunks  # kernel buffer full: let drain() wait it out
+            if sent <= 0:
+                return chunks
+            chunks = advance_chunks(chunks, sent)
+        return chunks
+
     async def _flush_outbox(self):
         """Single flusher per connection: one gather-write + one drain per
         batch of queued frames. Loops until the outbox is empty (appends
@@ -569,6 +626,11 @@ class Connection:
             t0 = time.perf_counter()
             try:
                 writer = self.writer
+                # vectored fast path: one sendmsg gather-write per syscall
+                # straight on the socket while the transport has nothing
+                # buffered (FIFO safety); whatever the kernel would not
+                # take resumes — mid-chunk — through the transport
+                chunks = self._send_vectored(writer, chunks)
                 for c in chunks:
                     writer.write(c)
                 await writer.drain()

@@ -1,0 +1,28 @@
+"""Peer-to-peer stream transport plane (cross-node compiled-graph channels).
+
+A third data plane, distinct from both the request/response rpc plane
+(`core/rpc.py`: asyncio frames, coalesced, handler dispatch) and the
+pull-based native object fetch (`core/object_store/native/`: one-shot GET of
+a sealed shm file): persistent worker-to-worker stream connections carrying
+an ordered sequence of message slots with credit-based flow control. This is
+what a compiled graph's cross-node edges ride (`cgraph/net_channel.py`);
+reference analog: the channel transports under
+python/ray/experimental/channel/ with src/ray/object_manager/ as the bulk
+data plane.
+
+See :mod:`ray_tpu.core.transport.stream` for the wire format.
+"""
+
+from ray_tpu.core.transport.stream import (  # noqa: F401
+    ReaderState,
+    StreamAuthError,
+    StreamClosedError,
+    StreamListener,
+    StreamSeveredError,
+    StreamTimeoutError,
+    TransportError,
+    WriterState,
+    connect_writer,
+    dumps_oob,
+    get_listener,
+)

@@ -191,15 +191,20 @@ class StreamingExecutor:
         return gen()
 
     # -------------------------------------------------------------- stages
-    def _bounded(self, submit_iter: Iterator[Any]) -> Iterator[Any]:
-        """Pull refs from submit_iter keeping <= max_in_flight outstanding;
-        yield in submission order (preserve_order) or completion order."""
+    def _bounded(self, submit_iter: Iterator[Any],
+                 max_in_flight: Optional[int] = None) -> Iterator[Any]:
+        """Pull refs from submit_iter keeping <= max_in_flight outstanding
+        (a PER-STAGE parameter — stages with their own capacity, like actor
+        pools, pass it explicitly rather than mutating the executor-wide
+        default, which concurrent stages observe); yield in submission order
+        (preserve_order) or completion order."""
         import ray_tpu
 
+        limit = max_in_flight if max_in_flight is not None else self.max_in_flight
         inflight: List[Any] = []
         for ref in submit_iter:
             inflight.append(ref)
-            while len(inflight) >= self.max_in_flight:
+            while len(inflight) >= limit:
                 if self.preserve_order:
                     yield inflight.pop(0)
                 else:
@@ -250,13 +255,14 @@ class StreamingExecutor:
                 actor = pool[i % strategy.size]
                 yield actor.map_block.remote(block_ref)
 
-        # reuse _bounded but with the pool's own capacity
-        saved = self.max_in_flight
-        self.max_in_flight = min(saved, cap) if cap else saved
-        try:
-            yield from self._bounded(submit())
-        finally:
-            self.max_in_flight = saved
+        # the pool's own capacity bounds THIS stage only: passing it into
+        # _bounded (instead of clobbering self.max_in_flight around a LAZY
+        # generator, whose save/restore bracketed creation — not iteration —
+        # so every concurrently-running stage observed the pool's cap)
+        yield from self._bounded(
+            submit(), max_in_flight=min(self.max_in_flight, cap) if cap
+            else None,
+        )
 
     def _limit_stream(self, op: LimitOp, upstream: Iterator[Any]) -> Iterator[Any]:
         """Truncate the stream after `limit` rows (fetches counts as it goes)."""

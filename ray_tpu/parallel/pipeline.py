@@ -135,6 +135,13 @@ class ActorPipeline:
     outputs in order. Per-microbatch dispatch cost is a channel write per
     hop — no task submission on the hot path.
 
+    Stages may live on DIFFERENT hosts: pin them with ``stage_resources``
+    (e.g. one TPU host per stage) and the compiled-graph planner gives
+    every cross-node hop a stream-transport ``NetChannel`` — activations
+    hand to the next host over a persistent credit-gated connection, with
+    ``max_in_flight`` bounding the microbatches in flight per edge end to
+    end. Same-host hops stay on shared-memory rings.
+
         pipe = ActorPipeline([preprocess, tpu_stage, postprocess])
         try:
             outs = pipe.run(batches)

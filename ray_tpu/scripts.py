@@ -226,18 +226,62 @@ def render_metrics_snapshot(samples) -> str:
     return "\n".join(lines) + "\n"
 
 
+def samples_from_dashboard_json(data) -> list:
+    """Convert ``/api/timeseries`` JSON (points as ``[{"tags", "value"}]``
+    lists) back into the internal sample shape (points keyed by sorted tag
+    tuples) that ``render_metrics_snapshot`` / ``util.metrics`` math
+    consume. Pure function — the HTTP-mode CLI and its tests share it."""
+    return [
+        {
+            "ts": s["ts"],
+            "series": [
+                {
+                    "name": x["name"],
+                    "kind": x.get("kind"),
+                    "boundaries": x.get("boundaries") or [],
+                    "points": {
+                        tuple(sorted(p.get("tags", {}).items())): p["value"]
+                        for p in x.get("points", [])
+                    },
+                }
+                for x in s.get("series", [])
+            ],
+        }
+        for s in data
+    ]
+
+
+def _fetch_timeseries_http(dashboard: str, limit: int) -> list:
+    """Read the metrics time series from a dashboard's ``/api/timeseries``
+    over plain HTTP — no driver connection (and no cluster token) needed,
+    so `scripts metrics --watch` can point at any reachable dashboard."""
+    import urllib.request
+
+    base = dashboard if "://" in dashboard else f"http://{dashboard}"
+    url = base.rstrip("/") + f"/api/timeseries?limit={int(limit)}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        data = json.load(resp)
+    return samples_from_dashboard_json(data)
+
+
 def cmd_metrics(args) -> int:
     """Top-like SLO view over the GCS metrics time series: per-deployment
-    QPS/p50/p99/errors plus node gauges; --watch refreshes in place."""
+    QPS/p50/p99/errors plus node gauges; --watch refreshes in place. With
+    --dashboard the samples come over HTTP from /api/timeseries instead of
+    requiring a driver connection to the cluster."""
     import time as _time
 
-    _connect(args)
-    from ray_tpu.util import state
+    if not args.dashboard:
+        _connect(args)
+        from ray_tpu.util import state
 
     rounds = args.count if args.watch else 1
     i = 0
     while rounds <= 0 or i < rounds:
-        samples = state.get_metrics_timeseries(limit=args.window)
+        if args.dashboard:
+            samples = _fetch_timeseries_http(args.dashboard, args.window)
+        else:
+            samples = state.get_metrics_timeseries(limit=args.window)
         if args.watch and sys.stdout.isatty():
             print("\x1b[2J\x1b[H", end="")
         print(render_metrics_snapshot(samples), end="", flush=True)
@@ -320,6 +364,10 @@ def main(argv=None) -> int:
         "deployment, node gauges)",
     )
     p.add_argument("--address")
+    p.add_argument("--dashboard",
+                   help="dashboard address (host:port or http://...): read "
+                        "/api/timeseries over HTTP instead of connecting a "
+                        "driver to the cluster")
     p.add_argument("--watch", action="store_true",
                    help="refresh continuously")
     p.add_argument("--interval", type=float, default=2.0)

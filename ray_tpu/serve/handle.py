@@ -593,21 +593,16 @@ class DeploymentHandle:
     def compile(self, *, max_in_flight: int = 8) -> "CompiledDeploymentHandle":
         """Compiled fast path: pin ONE replica and stream requests through a
         pre-allocated channel pair (ray_tpu/cgraph/) instead of per-request
-        task submission. Trades routing (no load balancing, no failover to
-        other replicas) for dispatch latency — the Serve analog of what
-        vLLM does with compiled graphs for pipeline parallelism. The graph
-        loop occupies one of the replica's ``max_ongoing_requests``
-        concurrency slots (health checks and routed requests keep the
-        rest); a replica can host at most one compiled handle at a time.
-        Call ``.teardown()`` when done."""
-        from ray_tpu.cgraph import actor_in_compiled_graph
-
-        replicas = self._router.wait_for_replicas(self.deployment_name)
-        free = [r for r in replicas if not actor_in_compiled_graph(r)]
-        # prefer a replica no other compiled handle has pinned; if all are
-        # taken, fall through and let compile raise its clear error
-        replica = (free or replicas)[0]
-        return CompiledDeploymentHandle(self.deployment_name, replica,
+        task submission. Trades load balancing for dispatch latency — the
+        Serve analog of what vLLM does with compiled graphs for pipeline
+        parallelism. The graph loop occupies one of the replica's
+        ``max_ongoing_requests`` concurrency slots (health checks and routed
+        requests keep the rest); a replica can host at most one compiled
+        handle at a time. If the pinned replica dies, the handle RECOMPILES
+        on a healthy replica and re-dispatches the failed request (once per
+        request), instead of failing until a manual recompile. Call
+        ``.teardown()`` when done."""
+        return CompiledDeploymentHandle(self.deployment_name, self._router,
                                         max_in_flight=max_in_flight)
 
     def stream(self, *args, **kwargs):
@@ -658,26 +653,128 @@ class DeploymentHandle:
 
 
 class CompiledDeploymentHandle:
-    """One pinned replica behind a compiled single-node graph; see
-    DeploymentHandle.compile(). ``remote()`` returns a CompiledDAGRef
-    (``.get()`` for the result); exceptions raised by the deployment
-    surface at get() like on the routed path."""
+    """One pinned replica behind a compiled graph; see
+    DeploymentHandle.compile(). ``remote()`` returns a ref (``.get()`` for
+    the result); exceptions raised by the deployment surface at get() like
+    on the routed path.
 
-    def __init__(self, deployment_name: str, replica, *, max_in_flight: int = 8):
+    Fault tolerance (ROADMAP cgraph-FT gap): when the pinned replica dies,
+    the handle evicts it from routing, recompiles over a HEALTHY replica,
+    and re-dispatches the affected request once — callers keep their refs,
+    matching the routed path's one-retry semantics."""
+
+    def __init__(self, deployment_name: str, router, *, max_in_flight: int = 8):
+        self.deployment_name = deployment_name
+        self._router = router
+        self._max_in_flight = max_in_flight
+        self._lock = threading.Lock()
+        self._compiled = None
+        self._replica = None
+        self._closed = False
+        with self._lock:
+            self._compile_on_healthy()
+
+    def _compile_on_healthy(self):
+        """(Re)compile over a live replica nothing else has pinned; called
+        under self._lock."""
+        from ray_tpu.cgraph import actor_in_compiled_graph
         from ray_tpu.dag import InputNode
 
-        self.deployment_name = deployment_name
-        self._replica = replica
+        replicas = self._router.wait_for_replicas(self.deployment_name)
+        free = [r for r in replicas if not actor_in_compiled_graph(r)]
+        # prefer a replica no other compiled handle has pinned; if all are
+        # taken, fall through and let compile raise its clear error
+        replica = (free or replicas)[0]
         with InputNode() as inp:
             dag = replica.handle_request.bind(inp)
-        self._compiled = dag.experimental_compile(max_in_flight=max_in_flight)
+        self._compiled = dag.experimental_compile(
+            max_in_flight=self._max_in_flight
+        )
+        self._replica = replica
+
+    def _recover(self, failed_dag) -> None:
+        """The pinned replica died (or is restarting): tear the dead graph
+        down, evict the replica from routing so new traffic avoids it, and
+        recompile on a healthy one. Idempotent per failed graph — late
+        callers holding refs from ``failed_dag`` skip the rebuild a racer
+        already did."""
+        with self._lock:
+            if self._closed or self._compiled is not failed_dag:
+                # torn down, or another caller already recovered past this
+                # graph — never resurrect a loop nothing will release
+                return
+            dead, self._replica = self._replica, None
+            try:
+                self._compiled.teardown(timeout=2.0)
+            except Exception:  # noqa: BLE001 - dead loops, closed channels
+                pass
+            if dead is not None:
+                # only report a replica the control plane agrees is gone: a
+                # severed cross-node channel can strand a LIVE replica, and
+                # recompiling (fresh channels) is recovery enough for that
+                from ray_tpu.api import _global_worker
+
+                try:
+                    state = _global_worker().backend.actor_state(
+                        dead._actor_id
+                    )
+                except Exception:  # noqa: BLE001
+                    state = "UNKNOWN"
+                if state in ("DEAD", "RESTARTING"):
+                    self._router._on_replica_failure(
+                        self.deployment_name, dead
+                    )
+            self._compile_on_healthy()
 
     def remote(self, request, timeout: Optional[float] = None):
         """Submit one request (a single positional value; use a tuple/dict
         for structured payloads). Blocks when max_in_flight requests are
         already buffered."""
-        return self._compiled.execute(request, timeout=timeout)
+        from ray_tpu.cgraph import ChannelSeveredError
+
+        dag = self._compiled
+        try:
+            ref = dag.execute(request, timeout=timeout)
+        except (exc.ActorDiedError, exc.ActorUnavailableError,
+                ChannelSeveredError):
+            # replica death OR a severed cross-node channel (the pinned
+            # replica may live on another host): both recompile
+            self._recover(dag)
+            ref = self._compiled.execute(request, timeout=timeout)
+        return _CompiledServeRef(self, request, ref)
 
     def teardown(self):
         """Release the pinned replica back to ordinary routed serving."""
-        self._compiled.teardown()
+        with self._lock:
+            self._closed = True
+            if self._compiled is not None:
+                self._compiled.teardown()
+
+
+class _CompiledServeRef:
+    """Result handle that retries THROUGH a recompile: a pinned-replica
+    death between submit and get() re-dispatches this request on the
+    recompiled graph (once) instead of surfacing the dead replica."""
+
+    def __init__(self, handle: CompiledDeploymentHandle, request, ref):
+        self._handle = handle
+        self._request = request
+        self._ref = ref
+        self._retried = False
+
+    def get(self, timeout: Optional[float] = None):
+        from ray_tpu.cgraph import ChannelSeveredError
+
+        try:
+            return self._ref.get(timeout=timeout)
+        except (exc.ActorDiedError, exc.ActorUnavailableError,
+                ChannelSeveredError):
+            if self._retried:
+                raise
+            self._retried = True
+            dag = self._ref._dag
+            self._handle._recover(dag)
+            self._ref = self._handle._compiled.execute(
+                self._request, timeout=timeout
+            )
+            return self._ref.get(timeout=timeout)

@@ -30,6 +30,10 @@ point                 where it fires
                       _stream_items`` / ``local_backend._drive_stream``) —
                       the producer dies right before yielding the Nth item,
                       so consumers must see a typed error on the next item
+``channel.send``      ``cgraph/net_channel.py`` ``NetChannel.write`` — the
+                      Nth write on a cross-node compiled-graph channel
+                      severs its stream connection (or is delayed), so
+                      both endpoints observe a mid-stream transport loss
 ====================  ======================================================
 
 Usage (context-manager API)::
@@ -116,6 +120,14 @@ class ChaosPlan:
         kill, then a typed ActorDiedError/WorkerCrashedError on the next
         item — never a hang or a silent end-of-stream."""
         return self._rule("stream.yield", "kill", match=match, nth=after_items)
+
+    def sever_channel(self, match: str = "", nth: int = 1) -> "ChaosPlan":
+        """Sever a cross-node compiled-graph channel's stream connection at
+        the Nth ``NetChannel.write`` whose channel id contains ``match``
+        (empty = any net channel). Both endpoints observe a mid-stream
+        connection loss: the writer raises ``ChannelSeveredError``
+        immediately, the reader on its next blocked read — never a hang."""
+        return self._rule("channel.send", "sever", match=match, nth=nth)
 
     def drop_rpc(self, method: str, nth: int = 1) -> "ChaosPlan":
         """Silently drop the Nth outbound request frame for ``method``."""

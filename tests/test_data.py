@@ -414,3 +414,40 @@ def test_read_json_from_pandas_write_parquet(ray_start_local, tmp_path):
     assert len(files) == 3
     back = rd.read_parquet(str(outdir))
     assert sorted(r["id"] for r in back.take_all()) == list(builtins_range(40))
+
+
+def test_actor_pool_stage_does_not_clobber_executor_cap(ray_start_local):
+    """An actor-pool stage's in-flight cap is a PER-STAGE _bounded
+    parameter: while its lazy stream drains, a concurrently-pulled
+    task-based stage still sees the executor-wide max_in_flight (the old
+    save/restore around the generator leaked the pool's cap to every
+    other stage for the stage's whole lifetime)."""
+    from ray_tpu.data.executor import (
+        ActorPoolStrategy,
+        MapBatchesOp,
+        ReadOp,
+        StreamingExecutor,
+    )
+
+    ex = StreamingExecutor(max_tasks_in_flight=8)
+    ops = [
+        ReadOp([(lambda i=i: {"id": np.array([i])}) for i in range(6)]),
+        MapBatchesOp(
+            fn=lambda b: {"id": b["id"] + 100},
+            compute=ActorPoolStrategy(
+                size=1, max_tasks_in_flight_per_actor=1
+            ),
+        ),
+        MapBatchesOp(fn=lambda b: {"id": b["id"] * 2}),
+    ]
+    caps_seen = []
+    stream = ex.execute(ops)
+    import ray_tpu
+
+    out = []
+    for ref in stream:
+        # mid-drain: the executor-wide cap must be untouched by the pool
+        caps_seen.append(ex.max_in_flight)
+        out.append(int(ray_tpu.get(ref)["id"][0]))
+    assert sorted(out) == [(i + 100) * 2 for i in range(6)]
+    assert set(caps_seen) == {8}, caps_seen

@@ -452,3 +452,33 @@ def test_deployment_composition_graph(serve_cluster):
     assert ray.get(pre_handle.remote("  X "), timeout=30) == "x"
     for name in ("ingress", "model_a", "model_b", "pre"):
         serve.delete(name)
+
+
+def test_compiled_handle_recompiles_on_replica_death(serve_cluster):
+    """ROADMAP cgraph-FT gap: when a compiled handle's pinned replica dies,
+    the handle recompiles over a HEALTHY replica and re-dispatches the
+    failed request — callers keep their refs; no manual recompile."""
+    ray, serve = serve_cluster
+
+    @serve.deployment(name="ft_doubler", num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    handle = serve.run(Doubler.bind())
+    compiled = handle.compile(max_in_flight=4)
+    try:
+        assert compiled.remote(21).get(timeout=30) == 42
+        pinned = compiled._replica
+        ray.kill(pinned, no_restart=True)
+        time.sleep(0.5)
+        # the next dispatch observes the death, recompiles, and retries
+        assert compiled.remote(5).get(timeout=60) == 10
+        assert (
+            compiled._replica._actor_id.binary()
+            != pinned._actor_id.binary()
+        )
+        assert compiled.remote(7).get(timeout=30) == 14
+    finally:
+        compiled.teardown()
+        serve.delete("ft_doubler")

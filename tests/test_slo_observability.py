@@ -377,6 +377,17 @@ def test_serve_slo_pipeline_cluster(cluster):
 
         rendered = render_metrics_snapshot(state.get_metrics_timeseries())
         assert "Echo" in rendered and "qps" in rendered
+
+        # `scripts metrics --dashboard`: the HTTP path renders the SAME
+        # view from /api/timeseries with NO driver connection — the JSON
+        # converter restores the internal tag-tuple point keys
+        from ray_tpu.scripts import _fetch_timeseries_http
+
+        http_samples = _fetch_timeseries_http(
+            dash.url, limit=30
+        )
+        http_rendered = render_metrics_snapshot(http_samples)
+        assert "Echo" in http_rendered and "qps" in http_rendered
         dash.stop()
 
         # per-job retention plumbing: task events carry the driver's job id
@@ -453,3 +464,48 @@ def test_wal_recovers_sigkilled_worker_events():
                 ray_tpu.shutdown()
     finally:
         os.environ.pop("RAY_TPU_TASK_EVENTS_FLUSH_INTERVAL_MS", None)
+
+
+def test_samples_from_dashboard_json_roundtrip():
+    """The /api/timeseries JSON shape (points as tag-dict lists) converts
+    back into the internal sample shape the metrics math consumes: rates
+    and histogram percentiles computed over HTTP-fetched samples match the
+    driver-connection path."""
+    from ray_tpu.scripts import samples_from_dashboard_json
+    from ray_tpu.util.metrics import counter_rate, window_percentile
+
+    data = [
+        {
+            "ts": 100.0,
+            "series": [
+                {"name": "serve_requests_total", "kind": "counter",
+                 "boundaries": [],
+                 "points": [{"tags": {"deployment": "d"}, "value": 10.0}]},
+                {"name": "serve_request_latency_ms", "kind": "histogram",
+                 "boundaries": [1.0, 10.0],
+                 "points": [{"tags": {"deployment": "d"},
+                             "value": [0.0, 0.0, 0.0, 0.0, 0.0]}]},
+            ],
+        },
+        {
+            "ts": 110.0,
+            "series": [
+                {"name": "serve_requests_total", "kind": "counter",
+                 "boundaries": [],
+                 "points": [{"tags": {"deployment": "d"}, "value": 30.0}]},
+                {"name": "serve_request_latency_ms", "kind": "histogram",
+                 "boundaries": [1.0, 10.0],
+                 "points": [{"tags": {"deployment": "d"},
+                             "value": [0.0, 20.0, 0.0, 110.0, 20.0]}]},
+            ],
+        },
+    ]
+    samples = samples_from_dashboard_json(data)
+    assert samples[0]["series"][0]["points"] == {
+        (("deployment", "d"),): 10.0
+    }
+    assert counter_rate(samples, "serve_requests_total",
+                        {"deployment": "d"}) == pytest.approx(2.0)
+    p50 = window_percentile(samples, "serve_request_latency_ms", 0.5,
+                            {"deployment": "d"})
+    assert p50 is not None and 1.0 <= p50 <= 10.0
