@@ -30,7 +30,7 @@ from ray_tpu.core.refs import ObjectRef
 from ray_tpu.streaming import ObjectRefGenerator
 from ray_tpu import exceptions
 from ray_tpu import tracing
-from ray_tpu.tracing import profile_span
+from ray_tpu.tracing import profile_span, remaining_time_s
 
 __all__ = [
     "__version__",
@@ -55,4 +55,5 @@ __all__ = [
     "exceptions",
     "tracing",
     "profile_span",
+    "remaining_time_s",
 ]

@@ -172,15 +172,13 @@ class NetChannel:
         reader = _tr.ReaderState(
             self.channel_id, self.token, self.max_msgs, self._spool_dir()
         )
+        # bind-all listeners advertise the host peers already reach this
+        # node's raylet on (config.py's documented fallback) instead of
+        # loopback — resolution lives in the transport's advertise_host
+        raylet_addr = getattr(core, "raylet_address", None)
+        if raylet_addr:
+            _tr.set_default_advertise_host(raylet_addr.rsplit(":", 1)[0])
         host, port = _tr.get_listener().register(reader)
-        if host == "127.0.0.1" and _config.transport_bind_host in ("0.0.0.0",
-                                                                   ""):
-            # bind-all with no explicit advertise host: advertise the host
-            # peers already reach this node's raylet on (config.py's
-            # documented fallback) instead of loopback
-            raylet_addr = getattr(core, "raylet_address", None)
-            if raylet_addr:
-                host = raylet_addr.rsplit(":", 1)[0]
         self._reader = reader
         core.io.run(
             core._gcs_call_retrying(

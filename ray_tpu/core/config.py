@@ -121,6 +121,14 @@ class Config:
     # --- retries ------------------------------------------------------------
     task_max_retries: int = 3
     actor_max_restarts: int = 0
+    # exponential backoff between system-failure retries (task resubmits,
+    # lineage reconstruction, serve failover): delay(n) =
+    # min(max, base * multiplier^(n-1)) * (1 ± jitter), seeded deterministic
+    # under an active chaos plan (util/backoff.py)
+    retry_backoff_base_ms: float = 50.0
+    retry_backoff_max_ms: float = 5_000.0
+    retry_backoff_multiplier: float = 2.0
+    retry_backoff_jitter: float = 0.5
 
     # --- fault tolerance ----------------------------------------------------
     # compiled graphs: how often a blocked execute()/get() probes participant
@@ -139,6 +147,35 @@ class Config:
     # per-chunk stream waits (overridable per deployment via
     # request_timeout_s and per handle via DeploymentHandle.options)
     serve_request_timeout_s: float = 60.0
+
+    # --- serve overload protection ------------------------------------------
+    # admission control: default bound on a deployment's router-side queue
+    # (in-flight beyond replica capacity); overflow sheds typed
+    # BackPressureError instead of queueing unboundedly. Per-deployment
+    # override: Deployment.max_queued_requests.
+    serve_max_queued_requests: int = 1_000
+    # retry budget (SRE-style): every request deposits this fraction of a
+    # retry token; failover/recompile retries spend one token each, so
+    # total retries are bounded to ~ratio x request rate and cannot
+    # amplify an outage
+    serve_retry_budget_ratio: float = 0.1
+    # the bucket's initial grant: a cold deployment can make this many
+    # retries before any traffic has deposited tokens (afterwards the
+    # budget is strictly rate-based — ratio x request volume)
+    serve_retry_budget_min_tokens: float = 5.0
+    # cap of the token bucket (a long quiet period cannot bank an
+    # unbounded retry burst)
+    serve_retry_budget_burst: float = 50.0
+    # circuit breaking: consecutive replica-level failures (death,
+    # unavailability, timeouts, slow calls) that eject a replica from
+    # routing until a half-open probe succeeds
+    serve_circuit_failure_threshold: int = 3
+    # how long an open breaker keeps its replica ejected before one
+    # half-open probe request is let through
+    serve_circuit_cooldown_s: float = 5.0
+    # a completed call slower than this counts as a breaker failure
+    # (0 = slow-call detection off)
+    serve_circuit_slow_call_ms: float = 0.0
 
     # --- streaming generators ----------------------------------------------
     # un-acked stream_item pushes a producing worker keeps in flight when no

@@ -251,6 +251,43 @@ class CoreWorker:
         # actor lifecycle listeners fed by the GCS "actor" pubsub channel
         # (compiled graphs subscribe their participants here)
         self._actor_listeners: List[Any] = []
+        # shared retry policies (util/backoff.py): exponential + jitter,
+        # chaos-seed deterministic. Task resubmits/lineage use the config
+        # base; the actor path keeps its historical restart-backoff base.
+        self._retry_policy = None
+        self._actor_retry_policy = None
+
+    def _backoff(self, actor: bool = False):
+        from ray_tpu.util import backoff
+
+        if actor:
+            if self._actor_retry_policy is None:
+                self._actor_retry_policy = backoff.BackoffPolicy(
+                    base_s=_config.actor_restart_backoff_s
+                )
+            return self._actor_retry_policy
+        if self._retry_policy is None:
+            self._retry_policy = backoff.BackoffPolicy()
+        return self._retry_policy
+
+    def _shed_expired(self, spec: ts.TaskSpec) -> bool:
+        """Owner-side admission: True when the spec's deadline has already
+        passed — the caller sheds it typed instead of dispatching work
+        whose client gave up."""
+        if spec.deadline is None or time.time() < spec.deadline:
+            return False
+        from ray_tpu.util.metrics import deadline_expired_counter
+
+        c = deadline_expired_counter()
+        if c is not None:
+            c.inc(1.0, {"where": "owner"})
+        return True
+
+    def _deadline_error(self, spec: ts.TaskSpec) -> exc.DeadlineExceededError:
+        return exc.DeadlineExceededError(
+            f"task {spec.name} shed before dispatch: request deadline "
+            f"exceeded by {time.time() - spec.deadline:.3f}s"
+        )
 
     # ------------------------------------------------------------ lifecycle
     def connect(self):
@@ -1055,6 +1092,7 @@ class CoreWorker:
             trace_id=tracing.current_trace_id(),
             parent_task_id=tracing.current_task_id(),
             job_id=self.job_id or tracing.current_job_id(),
+            deadline=tracing.current_deadline(),
         )
         self.submitted_specs[task_id] = spec
         self._pin_task_args(task_id, enc_args, enc_kwargs)
@@ -1080,6 +1118,9 @@ class CoreWorker:
         fails with the typed error and the consumer's next item raises."""
         attempts = 0
         while True:
+            if self._shed_expired(spec):
+                self._fail_stream(spec, self._deadline_error(spec))
+                return
             try:
                 result = await self._submit_once(spec)
                 self._store_task_result(spec, [], result)
@@ -1093,6 +1134,7 @@ class CoreWorker:
                             "item; retry %d", spec.name, attempts,
                         )
                         spec.attempt = attempts
+                        await asyncio.sleep(self._backoff().delay(attempts))
                         continue
                 self._fail_stream(spec, e)
                 return
@@ -1108,6 +1150,11 @@ class CoreWorker:
     async def _submit_and_track(self, spec: ts.TaskSpec, refs: List[ObjectRef]):
         attempts = 0
         while True:
+            if self._shed_expired(spec):
+                self._store_task_error(
+                    refs, self._deadline_error(spec), spec=spec
+                )
+                return
             try:
                 result = await self._submit_once(spec)
                 self._store_task_result(spec, refs, result)
@@ -1122,6 +1169,9 @@ class CoreWorker:
                         "task %s worker crashed; retry %d", spec.name, attempts
                     )
                     spec.attempt = attempts
+                    # backoff (was: immediate re-dispatch — a dying node made
+                    # every owner hammer the raylet in lockstep)
+                    await asyncio.sleep(self._backoff().delay(attempts))
                     continue
                 self._store_task_error(refs, e, spec=spec)
                 return
@@ -1924,6 +1974,10 @@ class CoreWorker:
                 "reconstructing lost object(s) of task %s via lineage",
                 spec.name,
             )
+            if attempts > 0:
+                # repeated losses of the same lineage back off exponentially
+                # (a flapping node must not see a reconstruction hot loop)
+                await asyncio.sleep(self._backoff().delay(attempts))
             refs = spec.return_refs()
             for r in refs:
                 self.memory_store.delete(r.id)
@@ -2012,6 +2066,7 @@ class CoreWorker:
             trace_id=tracing.current_trace_id(),
             parent_task_id=tracing.current_task_id(),
             job_id=self.job_id or tracing.current_job_id(),
+            deadline=tracing.current_deadline(),
         )
         self._record_task_event(spec, "SUBMITTED")
         out = None
@@ -2059,6 +2114,16 @@ class CoreWorker:
             seq = st.next_seq
             st.next_seq += 1
             await st.gate.wait()        # closed while a recovery is replaying
+            if self._shed_expired(spec):
+                # queued past its deadline (window full behind a slow actor):
+                # shed typed without burning a wire round trip
+                if getattr(spec, "streaming", False):
+                    self._fail_stream(spec, self._deadline_error(spec))
+                else:
+                    self._store_task_error(
+                        refs, self._deadline_error(spec), spec=spec
+                    )
+                continue
             await st.sem.acquire()
             st.inflight[seq] = (spec, refs)
             try:
@@ -2198,6 +2263,11 @@ class CoreWorker:
         call_attempt = 0
         resolve_attempt = 0
         while True:
+            if self._shed_expired(spec):
+                self._store_task_error(
+                    refs, self._deadline_error(spec), spec=spec
+                )
+                return
             addr = await self._resolve_actor(spec.actor_id.binary())
             if addr is None:
                 self._store_task_error(
@@ -2215,7 +2285,9 @@ class CoreWorker:
                         spec=spec,
                     )
                     return
-                await asyncio.sleep(_config.actor_restart_backoff_s)
+                await asyncio.sleep(
+                    self._backoff(actor=True).delay(resolve_attempt)
+                )
                 continue
             try:
                 result = await conn.call_batched(
@@ -2247,7 +2319,9 @@ class CoreWorker:
                         spec=spec,
                     )
                     return
-                await asyncio.sleep(_config.actor_restart_backoff_s)
+                await asyncio.sleep(
+                    self._backoff(actor=True).delay(call_attempt)
+                )
 
     async def _resolve_actor(self, actor_id: bytes) -> Optional[str]:
         addr = self._actor_addr_cache.get(actor_id)

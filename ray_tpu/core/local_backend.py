@@ -139,6 +139,37 @@ class LocalBackend(Backend):
         ).start()
         # chaos "kill" actions executed on an actor thread route here
         chaos.set_local_actor_killer(self._chaos_kill_current)
+        self._backoff_policy = None  # lazy (util/backoff, chaos-seeded)
+
+    def _retry_backoff(self):
+        from ray_tpu.util import backoff
+
+        if self._backoff_policy is None:
+            self._backoff_policy = backoff.BackoffPolicy()
+        return self._backoff_policy
+
+    def _shed_expired(self, name: str, deadline: Optional[float],
+                      refs=None, stream=None) -> bool:
+        """Pre-execution admission (cluster worker parity): a task whose
+        request deadline passed while it queued is failed typed without
+        running user code. Fails `refs` or `stream` with
+        DeadlineExceededError; returns True when shed."""
+        if deadline is None or time.time() < deadline:
+            return False
+        from ray_tpu.util.metrics import deadline_expired_counter
+
+        c = deadline_expired_counter()
+        if c is not None:
+            c.inc(1.0, {"where": "worker"})
+        err = exc.DeadlineExceededError(
+            f"task {name} shed before execution: request deadline exceeded "
+            f"by {time.time() - deadline:.3f}s"
+        )
+        if stream is not None:
+            stream.fail(err)
+        elif refs is not None:
+            self._store_error(refs, err)
+        return True
 
     def _timeseries_loop(self):
         from ray_tpu.core.config import _config
@@ -392,13 +423,18 @@ class LocalBackend(Backend):
         except concurrent.futures.InvalidStateError:
             pass
 
-    def _drive_stream(self, state: StreamState, produce, chaos_key: str):
+    def _drive_stream(self, state: StreamState, produce, chaos_key: str,
+                      deadline: Optional[float] = None):
         """Producer loop: run the generator, publishing each item as its own
         object the moment it is yielded (push), blocking in wait_credit when
         a backpressure window is set. Mirrors the cluster worker's
         _stream_items with in-process stores."""
+        if self._shed_expired(state.name, deadline, stream=state):
+            self._record(state.task_id, state.name, "FAILED")
+            return
         self._record(state.task_id, state.name, "RUNNING")
-        with tracing.task_context(state.task_id.hex(), None):
+        with tracing.task_context(state.task_id.hex(), None,
+                                  deadline=deadline):
             self._drive_stream_impl(state, produce, chaos_key)
         self._record(
             state.task_id, state.name,
@@ -483,6 +519,7 @@ class LocalBackend(Backend):
         threading.Thread(
             target=self._drive_stream,
             args=(state, produce, getattr(func, "__name__", "")),
+            kwargs={"deadline": tracing.current_deadline()},
             daemon=True,
             name=f"stream-{state.task_id.hex()[:8]}",
         ).start()
@@ -500,6 +537,7 @@ class LocalBackend(Backend):
             ))
             return ObjectRefGenerator(state)
         actor.pending_streams.add(state)
+        deadline = tracing.current_deadline()
 
         def run():
             _current_actor.actor_id = actor_id
@@ -508,6 +546,8 @@ class LocalBackend(Backend):
                     actor.ensure_initialized()
                 except BaseException as e:  # noqa: BLE001 - init failed
                     state.fail(exc.ActorDiedError(actor_id, f"init failed: {e!r}"))
+                    return
+                if self._shed_expired(method_name, deadline, stream=state):
                     return
                 key = f"{type(actor.instance).__name__}.{method_name}"
 
@@ -520,7 +560,7 @@ class LocalBackend(Backend):
                         *rargs, **rkwargs
                     )
 
-                self._drive_stream(state, produce, key)
+                self._drive_stream(state, produce, key, deadline=deadline)
             finally:
                 _current_actor.actor_id = None
                 actor.pending_streams.discard(state)
@@ -544,6 +584,7 @@ class LocalBackend(Backend):
         name = getattr(func, "__name__", "task")
         trace_id = tracing.current_trace_id()
         parent_id = tracing.current_task_id()
+        deadline = tracing.current_deadline()
         self._record(task_id, name, "SUBMITTED", trace_id=trace_id,
                      parent_id=parent_id)
 
@@ -554,7 +595,11 @@ class LocalBackend(Backend):
                 else 0 if not options.retry_exceptions else 3
             )
             attempt = 0
-            with tracing.task_context(task_id.hex(), trace_id):
+            with tracing.task_context(task_id.hex(), trace_id,
+                                      deadline=deadline):
+                if self._shed_expired(name, deadline, refs):
+                    self._record(task_id, name, "FAILED", trace_id=trace_id)
+                    return
                 self._record(task_id, name, "RUNNING", trace_id=trace_id)
                 while True:
                     if task_id in self._cancelled:
@@ -571,6 +616,7 @@ class LocalBackend(Backend):
                     except Exception as e:  # noqa: BLE001 - user exception boundary
                         attempt += 1
                         if options.retry_exceptions and attempt <= retries:
+                            time.sleep(self._retry_backoff().delay(attempt))
                             continue
                         self._store_error(refs, e)
                         self._record(task_id, name, "FAILED", trace_id=trace_id)
@@ -625,6 +671,7 @@ class LocalBackend(Backend):
         actor.pending_refs.update(refs)
         trace_id = tracing.current_trace_id()
         parent_id = tracing.current_task_id()
+        deadline = tracing.current_deadline()
         self._record(task_id, method_name, "SUBMITTED", actor_id=actor_id,
                      trace_id=trace_id, parent_id=parent_id)
 
@@ -634,7 +681,12 @@ class LocalBackend(Backend):
                 from ray_tpu.actor import CGRAPH_CALL_METHOD
 
                 actor.ensure_initialized()
-                with tracing.task_context(task_id.hex(), trace_id):
+                with tracing.task_context(task_id.hex(), trace_id,
+                                          deadline=deadline):
+                    if self._shed_expired(method_name, deadline, refs):
+                        self._record(task_id, method_name, "FAILED",
+                                     actor_id=actor_id, trace_id=trace_id)
+                        return
                     self._record(task_id, method_name, "RUNNING",
                                  actor_id=actor_id, trace_id=trace_id)
                     rargs, rkwargs = self._resolve_args(args, kwargs)

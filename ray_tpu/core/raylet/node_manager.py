@@ -743,6 +743,7 @@ class Raylet:
 
     async def _on_worker_death(self, handle: WorkerHandle):
         await self._recover_worker_wal(handle)
+        self._reclaim_worker_spools(handle)
         # tombstone any cross-node channel endpoints the dead worker
         # advertised: writers blocked in get_channel_endpoint fail fast
         # typed instead of dialing a ghost until their connect timeout
@@ -824,6 +825,37 @@ class Raylet:
         except (rpc.RpcError, rpc.ConnectionLost):
             return False
 
+    def _reclaim_worker_spools(self, handle: WorkerHandle) -> None:
+        """A worker died: unlink any cross-node channel spool files it
+        still pinned in the session's ``cgraph_net/`` dir (a SIGKILLed
+        stream reader never ran its release path — without this they
+        lingered until session teardown). The periodic session sweep
+        backstops workers that die with the raylet."""
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        spool_dir = os.path.join(session_dir(self.session), "cgraph_net")
+        pid = getattr(handle.proc, "pid", None)
+        if pid is None:
+            return
+        prefix = f"p{pid}_"
+        try:
+            names = os.listdir(spool_dir)
+        except OSError:
+            return
+        removed = 0
+        for name in names:
+            if name.startswith(prefix):
+                try:
+                    os.unlink(os.path.join(spool_dir, name))
+                    removed += 1
+                except OSError:
+                    pass
+        if removed:
+            logger.info(
+                "reclaimed %d spool file(s) of dead worker pid=%d",
+                removed, pid,
+            )
+
     def _wal_node_of(self, name: str) -> Optional[str]:
         """Node id embedded in a WAL filename (wal-<node>-<token>.jsonl)."""
         if not (name.startswith("wal-") and name.endswith(".jsonl")):
@@ -866,8 +898,20 @@ class Raylet:
         from ray_tpu.core.object_store.shm_store import session_dir
 
         wal_dir = os.path.join(session_dir(self.session), "task_wal")
+        spool_dir = os.path.join(session_dir(self.session), "cgraph_net")
         while True:
             await asyncio.sleep(30.0)
+            # session hygiene shares this cadence: reclaim cgraph_net spool
+            # files whose reader process died (pid-tagged names; SIGKILLed
+            # readers never release them — ROADMAP open item)
+            try:
+                from ray_tpu.core.transport import sweep_spool_dir
+
+                await asyncio.get_event_loop().run_in_executor(
+                    None, sweep_spool_dir, spool_dir
+                )
+            except Exception:  # noqa: BLE001 - hygiene must not kill the loop
+                logger.exception("spool sweep failed")
             if not _config.task_events_wal_enabled:
                 continue
             try:

@@ -66,6 +66,12 @@ class TaskSpec:
     trace_id: Optional[str] = None
     parent_task_id: Optional[str] = None
     attempt: int = 0
+    # overload protection: absolute wall-clock deadline (epoch seconds) of
+    # the root request, inherited by nested submissions via the worker task
+    # context. Expired specs are shed typed (DeadlineExceededError) before
+    # dispatch at the owner AND before execution at the worker — abandoned
+    # requests never burn replica/worker time.
+    deadline: Optional[float] = None
 
     def return_refs(self) -> List[ObjectRef]:
         return [

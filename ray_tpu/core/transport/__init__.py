@@ -25,4 +25,6 @@ from ray_tpu.core.transport.stream import (  # noqa: F401
     connect_writer,
     dumps_oob,
     get_listener,
+    set_default_advertise_host,
+    sweep_spool_dir,
 )

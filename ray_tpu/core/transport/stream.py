@@ -53,6 +53,7 @@ import logging
 import mmap
 import os
 import pickle
+import re
 import socket
 import struct
 import threading
@@ -292,8 +293,12 @@ class ReaderState:
             # as views over node-local tmpfs with no extra copy
             os.makedirs(self.spool_dir, exist_ok=True)
             self._spool_counter += 1
+            # pid-tagged name: the raylet's session sweep reclaims spool
+            # files whose reader process died without releasing them
+            # (SIGKILL mid-read) — see sweep_spool_dir()
             path = os.path.join(
-                self.spool_dir, f"{self.channel_id}_{self._spool_counter}"
+                self.spool_dir,
+                f"p{os.getpid()}_{self.channel_id}_{self._spool_counter}",
             )
             total = sum(sizes)
             f = open(path, "w+b")
@@ -634,11 +639,17 @@ class StreamListener:
 
     @property
     def advertise_host(self) -> str:
+        """Host peers should DIAL for this listener. Resolution order:
+        ``transport_advertise_host`` (explicit multi-host config) → the
+        bound host when it is a real address → the node's default
+        advertise host (the raylet's host, set at core-worker startup) →
+        loopback. This is the multi-host story: bind 0.0.0.0, advertise
+        the address peers already reach this node's raylet on."""
         if _config.transport_advertise_host:
             return _config.transport_advertise_host
         if self.host not in ("0.0.0.0", ""):
             return self.host
-        return "127.0.0.1"
+        return _default_advertise_host or "127.0.0.1"
 
     def register(self, reader: ReaderState) -> Tuple[str, int]:
         with self._lock:
@@ -712,6 +723,9 @@ class StreamListener:
 
 _listener: Optional[StreamListener] = None
 _listener_lock = threading.Lock()
+# node-level default advertise host (normally the raylet's host), used when
+# binding all interfaces with no explicit transport_advertise_host
+_default_advertise_host: str = ""
 
 
 def get_listener() -> StreamListener:
@@ -721,3 +735,67 @@ def get_listener() -> StreamListener:
         if _listener is None or _listener._closed:
             _listener = StreamListener()
         return _listener
+
+
+def set_default_advertise_host(host: str) -> None:
+    """Record the host peers reach THIS node on (the raylet's address);
+    a listener bound 0.0.0.0 with no ``transport_advertise_host`` override
+    advertises it instead of loopback. Called by the core worker when it
+    adopts a raylet — idempotent, last writer wins."""
+    global _default_advertise_host
+    if host and host not in ("0.0.0.0", ""):
+        _default_advertise_host = host
+
+
+# ------------------------------------------------------------- spool hygiene
+_SPOOL_PID_RE = re.compile(r"^p(\d+)_")
+
+
+def sweep_spool_dir(path: str, min_age_s: float = 30.0) -> int:
+    """Reclaim spool files whose reader process is gone.
+
+    Spool files (`p<pid>_<channel>_<n>`) are unlinked by the reader when
+    the message is released — but a SIGKILLed reader leaves them pinned in
+    the tmpfs session dir until session teardown. The raylet calls this on
+    its periodic session sweep: a file whose embedded pid is no longer
+    alive is deleted; files older than 10 minutes are reclaimed regardless
+    (legacy names / pid reuse backstop). ``min_age_s`` protects files a
+    live reader just created. Returns the number of files removed."""
+    removed = 0
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        p = os.path.join(path, name)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        age = now - st.st_mtime
+        if age < min_age_s:
+            continue
+        m = _SPOOL_PID_RE.match(name)
+        if m is not None:
+            pid = int(m.group(1))
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                alive = True
+            if alive and age < 600.0:
+                continue
+        elif age < 600.0:
+            continue  # un-tagged (pre-sweep) file: age out only
+        try:
+            os.unlink(p)
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        logger.info("reclaimed %d orphaned spool file(s) under %s",
+                    removed, path)
+    return removed

@@ -15,6 +15,7 @@ import contextlib
 import logging
 import os
 import threading
+import time
 import traceback
 from typing import Dict, Optional
 
@@ -170,14 +171,40 @@ class WorkerAgent(CoreWorker):
     def _task_ctx(self, spec: ts.TaskSpec):
         """Tracing context for the executing task: nested submissions made
         by the user function inherit this task as parent, ride the
-        request's trace id, and carry the job (all propagated through the
-        spec)."""
+        request's trace id, carry the job, and inherit the request deadline
+        (all propagated through the spec). User code reads the remaining
+        budget via ``ray_tpu.remaining_time_s()``."""
         return tracing.task_context(
             spec.task_id.hex(), getattr(spec, "trace_id", None),
             getattr(spec, "job_id", None),
+            deadline=getattr(spec, "deadline", None),
         )
 
+    def _shed_if_expired(self, spec: ts.TaskSpec):
+        """Pre-execution admission (overload protection): a spec whose
+        request deadline already passed is failed typed WITHOUT running
+        user code — the client stopped waiting, so executing it would only
+        steal worker time from requests that can still make their SLO.
+        Returns the error reply to send, or None to proceed."""
+        deadline = getattr(spec, "deadline", None)
+        if deadline is None or time.time() < deadline:
+            return None
+        from ray_tpu.util.metrics import deadline_expired_counter
+
+        c = deadline_expired_counter()
+        if c is not None:
+            c.inc(1.0, {"where": "worker"})
+        self._record_task_event(spec, "FAILED")
+        err = exc.DeadlineExceededError(
+            f"task {spec.name} shed before execution: request deadline "
+            f"exceeded by {time.time() - deadline:.3f}s"
+        )
+        return self._error_result(spec, err, system=True)
+
     def _execute(self, spec: ts.TaskSpec) -> dict:
+        shed = self._shed_if_expired(spec)
+        if shed is not None:
+            return shed
         applied = False
         self._record_task_event(spec, "RUNNING")
         try:
@@ -204,6 +231,7 @@ class WorkerAgent(CoreWorker):
                     except Exception as e:  # noqa: BLE001 - user exception
                         attempts += 1
                         if spec.retry_exceptions and attempts <= spec.max_retries:
+                            time.sleep(self._backoff().delay(attempts))
                             continue
                         return self._attach_borrows(spec, self._error_result(spec, e))
             self._record_task_event(spec, "EXECUTED")
@@ -347,6 +375,9 @@ class WorkerAgent(CoreWorker):
     # reference's generator_backpressure_num_objects.
 
     def _execute_streaming(self, spec: ts.TaskSpec, conn) -> dict:
+        shed = self._shed_if_expired(spec)
+        if shed is not None:
+            return shed
         applied = False
         self._record_task_event(spec, "RUNNING")
         try:
@@ -378,6 +409,9 @@ class WorkerAgent(CoreWorker):
         self._actor_ready.wait(timeout=_config.worker_startup_timeout_s)
         if self._actor_init_error is not None:
             return self._error_result(spec, self._actor_init_error)
+        shed = self._shed_if_expired(spec)
+        if shed is not None:
+            return shed
         self._record_task_event(spec, "RUNNING")
         try:
             from ray_tpu.testing import chaos
@@ -653,6 +687,9 @@ class WorkerAgent(CoreWorker):
         self._actor_ready.wait(timeout=_config.worker_startup_timeout_s)
         if self._actor_init_error is not None:
             return self._error_result(spec, self._actor_init_error)
+        shed = self._shed_if_expired(spec)
+        if shed is not None:
+            return shed
         self._record_task_event(spec, "RUNNING")
         try:
             from ray_tpu.actor import CGRAPH_CALL_METHOD

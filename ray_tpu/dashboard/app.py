@@ -118,6 +118,14 @@ async function slo() {
   if (p99.length) html += spark("serve p99", p99, "ms");
   const errs = rate(pts(samples, "serve_request_errors_total"));
   if (errs.length) html += spark("serve errors", errs, "/s");
+  // overload protection (PR 10): shed rate, deadline expirations, and the
+  // number of circuit-open replicas — the graceful-degradation dials
+  const shed = rate(pts(samples, "serve_shed_total"));
+  if (shed.length) html += spark("serve shed", shed, "/s");
+  const ddl = rate(pts(samples, "serve_deadline_expired_total"));
+  if (ddl.length) html += spark("deadline expired", ddl, "/s");
+  const circ = pts(samples, "serve_circuit_open").map(p => p.v);
+  if (circ.length) html += spark("circuits open", circ, "");
   const tq = pctl(samples, "task_e2e_ms", 0.99);
   if (tq.length) html += spark("task p99", tq, "ms");
   const depth = pts(samples, "raylet_pending_leases").map(p => p.v);

@@ -86,6 +86,28 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's end-to-end deadline expired before (or while) the work
+    ran. Minted at the serve proxy/handle (``request_timeout_s`` or the
+    client's timeout header), the deadline rides the task context and
+    ``TaskSpec`` into nested calls; every hop sheds expired work *before*
+    dispatch/execution, so an abandoned request never burns replica time."""
+
+
+class BackPressureError(RayTpuError):
+    """Admission control rejected the request: the deployment's queue bound
+    (``max_queued_requests``) or a replica's ``max_ongoing_requests`` is
+    full, or every replica's circuit breaker is open. Retryable by the
+    CLIENT after backing off (HTTP 503 + Retry-After at the proxy); the
+    framework itself never retries these — that would amplify the overload."""
+
+
+class RetryBudgetExhaustedError(RayTpuError):
+    """A failover retry was wanted but the deployment's retry token bucket
+    (a bounded fraction of recent request volume) is empty — the original
+    failure surfaces instead of joining a retry storm."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass
 

@@ -202,6 +202,9 @@ def main(duration: float = 2.0, json_path: str = ""):
     # ----------------------------------------------------- metrics overhead
     _metrics_overhead_benchmarks(ray_tpu, results, duration)
 
+    # ------------------------------------------------------------- overload
+    _overload_benchmarks(ray_tpu, results, duration)
+
     # ------------------------------------------------- cross-node cgraph
     _cross_node_benchmarks(ray_tpu, results, duration)
 
@@ -462,6 +465,92 @@ def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
             else:
                 os.environ[k] = v
         _config.metrics_enabled, _config.task_events_wal_enabled = saved_cfg
+
+
+def _overload_benchmarks(ray_tpu, results, duration: float):
+    """Saturate one deployment past capacity and measure what the client
+    experiences with and without admission control (PR-10 acceptance):
+
+    - admission ON (small max_queued_requests): overflow sheds typed in
+      ~micro­seconds — record the shed-path latency and the accepted
+      requests' p99;
+    - admission OFF (effectively unbounded queue): every request queues
+      behind the saturated replica — record the queued p99, the latency a
+      client actually eats when nothing sheds.
+    """
+    import threading
+    import time as _time
+
+    import numpy as _np
+
+    ray_tpu.init(local_mode=True)
+    from ray_tpu import exceptions as exc
+    from ray_tpu import serve
+
+    work_s = 0.02
+    burst = 32
+
+    def run_pass(label, max_queued):
+        @serve.deployment(
+            name=f"bench_{label}", max_ongoing_requests=2,
+            max_queued_requests=max_queued, request_timeout_s=60,
+        )
+        class Busy:
+            def __call__(self, x):
+                _time.sleep(work_s)
+                return x
+
+        handle = serve.run(Busy.bind())
+        assert ray_tpu.get(handle.remote(0), timeout=60) == 0
+        ok_lat, shed_lat = [], []
+        lock = threading.Lock()
+
+        def fire(i):
+            t0 = _time.perf_counter()
+            try:
+                ray_tpu.get(handle.remote(i), timeout=120)
+                with lock:
+                    ok_lat.append(_time.perf_counter() - t0)
+            except exc.BackPressureError:
+                with lock:
+                    shed_lat.append(_time.perf_counter() - t0)
+
+        threads = [
+            threading.Thread(target=fire, args=(i,)) for i in range(burst)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        serve.delete(f"bench_{label}")
+        return ok_lat, shed_lat
+
+    try:
+        ok_on, shed_on = run_pass("admit", max_queued=4)
+        ok_off, shed_off = run_pass("noadmit", max_queued=100_000)
+
+        def p99_ms(xs):
+            return float(_np.percentile(_np.array(xs) * 1000, 99)) if xs else 0.0
+
+        rows = [
+            ("overload shed latency p99 ms (admission on)", p99_ms(shed_on)),
+            ("overload accepted p99 ms (admission on)", p99_ms(ok_on)),
+            ("overload queued p99 ms (admission off)", p99_ms(ok_off)),
+        ]
+        for name, val in rows:
+            print(f"{name:<50s} {val:>10.2f} ms")
+            results.append({"name": name, "p99_ms": round(val, 2)})
+        results.append({
+            "name": "overload shed/accepted counts (admission on)",
+            "shed": len(shed_on), "accepted": len(ok_on),
+        })
+        print(
+            f"{'overload shed/accepted (admission on)':<50s} "
+            f"{len(shed_on)}/{len(ok_on)}"
+        )
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
 
 
 if __name__ == "__main__":

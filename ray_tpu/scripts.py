@@ -177,7 +177,7 @@ def render_metrics_snapshot(samples) -> str:
                 )
     header = (f"{'deployment':<24s} {'qps':>8s} {'p50 ms':>9s} "
               f"{'p99 ms':>9s} {'exec p99':>9s} {'err/s':>8s} "
-              f"{'inflight':>8s}")
+              f"{'shed/s':>8s} {'inflight':>8s} {'circ':>5s}")
     lines.append(header)
     lines.append("-" * len(header))
     for dep in sorted(deployments):
@@ -189,6 +189,10 @@ def render_metrics_snapshot(samples) -> str:
             samples, "serve_request_latency_ms", 0.99, tags)
         ex99 = window_percentile(samples, "serve_exec_latency_ms", 0.99, tags)
         errs = counter_rate(samples, "serve_request_errors_total", tags)
+        # overload-protection series (PR 10): shed rate (admission +
+        # deadline + replica rejects merge cluster-wide) and the number of
+        # replicas currently ejected by an open circuit breaker
+        sheds = counter_rate(samples, "serve_shed_total", tags)
         inflight = None
         s = series("serve_replica_inflight")
         if s:
@@ -196,10 +200,18 @@ def render_metrics_snapshot(samples) -> str:
                 v for tags_, v in s["points"].items()
                 if ("deployment", dep) in tags_
             )
+        circ = None
+        s = series("serve_circuit_open")
+        if s:
+            circ = sum(
+                v for tags_, v in s["points"].items()
+                if ("deployment", dep) in tags_
+            )
         lines.append(
             f"{dep:<24s} {_fmt_num(qps):>8s} {_fmt_num(p50):>9s} "
             f"{_fmt_num(p99):>9s} {_fmt_num(ex99):>9s} "
-            f"{_fmt_num(errs):>8s} {_fmt_num(inflight):>8s}"
+            f"{_fmt_num(errs):>8s} {_fmt_num(sheds):>8s} "
+            f"{_fmt_num(inflight):>8s} {_fmt_num(circ):>5s}"
         )
     if not deployments:
         lines.append("(no serve deployments reporting)")
@@ -210,6 +222,20 @@ def render_metrics_snapshot(samples) -> str:
         lines.append(f"task e2e p99: {t99:,.1f} ms   "
                      f"exec p99: "
                      f"{_fmt_num(window_percentile(samples, 'task_exec_ms', 0.99))} ms")
+    # overload-protection totals across deployments (rates over the window)
+    overload = []
+    for label, metric in (
+        ("shed/s", "serve_shed_total"),
+        ("deadline-expired/s", "serve_deadline_expired_total"),
+        ("budget-exhausted/s", "serve_retry_budget_exhausted_total"),
+        ("task-deadline-shed/s", "task_deadline_expired_total"),
+    ):
+        r = counter_rate(samples, metric)
+        if r is not None and r > 0:
+            overload.append(f"{label}={r:,.2f}")
+    if overload:
+        lines.append("")
+        lines.append("overload: " + "  ".join(overload))
     gauge_names = (
         "raylet_pending_leases", "raylet_active_leases",
         "object_store_used_bytes", "object_store_num_objects",

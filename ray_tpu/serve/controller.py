@@ -52,6 +52,9 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, Any] = {}     # name → Deployment
         self._replicas: Dict[str, _ReplicaSet] = {}
+        # name → replica key hex → breaker state routers reported
+        # ("open"/"half_open"; closed entries are removed)
+        self._circuit_states: Dict[str, Dict[str, str]] = {}
         self._version = 0
         self._lock = threading.Lock()
         # serializes whole reconcile passes: deploy() calls _reconcile from
@@ -82,6 +85,7 @@ class ServeController:
         with self._lock:
             self._deployments.pop(name, None)
             rs = self._replicas.pop(name, None)
+            self._circuit_states.pop(name, None)
         if rs:
             self._stop_replicas(rs.actors)
         self._bump()
@@ -112,14 +116,53 @@ class ServeController:
                     if getattr(d, "stream_backpressure_window", None)
                     is not None
                 },
+                # overload protection: routers enforce admission against
+                # these bounds (capacity = replicas x max_ongoing; overflow
+                # beyond max_queued sheds typed)
+                "max_ongoing": {
+                    name: d.max_ongoing_requests
+                    for name, d in self._deployments.items()
+                },
+                "max_queued": {
+                    name: d.max_queued_requests
+                    for name, d in self._deployments.items()
+                    if getattr(d, "max_queued_requests", None) is not None
+                },
             }
 
     def status(self) -> dict:
         with self._lock:
             return {
-                name: {"target": rs.target, "running": len(rs.actors)}
+                name: {
+                    "target": rs.target,
+                    "running": len(rs.actors),
+                    "circuit": dict(self._circuit_states.get(name, {})),
+                }
                 for name, rs in self._replicas.items()
             }
+
+    def report_replica_state(self, name: str, replica_key: bytes,
+                             state: str) -> bool:
+        """A router's circuit breaker transitioned for one of our replicas
+        (open = ejected from that router's routing, closed = restored by a
+        half-open probe). Recorded for operators (status()); the replica
+        keeps running — breakers protect callers from slow/flaky replicas
+        the health check still passes, so killing it here would be wrong."""
+        key_hex = (
+            replica_key.hex() if isinstance(replica_key, (bytes, bytearray))
+            else str(replica_key)
+        )
+        with self._lock:
+            states = self._circuit_states.setdefault(name, {})
+            if state == "closed":
+                states.pop(key_hex, None)
+            else:
+                states[key_hex] = state
+        logger.warning(
+            "replica %s of %r circuit %s (router-reported)",
+            key_hex[:12], name, state,
+        )
+        return True
 
     def report_dead_replica(self, name: str, replica_key: bytes) -> bool:
         """A router observed a replica die mid-request: drop it from the
@@ -134,6 +177,11 @@ class ServeController:
             for a in victims:
                 rs.actors.remove(a)
                 rs.born.pop(replica_key, None)
+            # a dead replica's breaker report dies with it (no router will
+            # ever report it closed)
+            states = self._circuit_states.get(name)
+            if states is not None:
+                states.pop(replica_key.hex(), None)
         if not victims:
             return False
         self._stop_replicas(victims)  # ensure the process is really gone
@@ -223,10 +271,18 @@ class ServeController:
 
         opts = dict(dep.ray_actor_options)
         opts.setdefault("num_cpus", 1)
-        opts.setdefault("max_concurrency", dep.max_ongoing_requests)
+        # +2 headroom over max_ongoing_requests: health checks/stats must
+        # never queue behind a saturated replica (a healthy-but-full
+        # replica used to look dead to the reconcile probe), and the spare
+        # slot lets the replica FAST-REJECT overflow typed
+        # (BackPressureError) instead of silently queueing it — the
+        # replica-side enforcement half of admission control. ServeReplica
+        # itself caps USER work at max_ongoing.
+        opts.setdefault("max_concurrency", dep.max_ongoing_requests + 2)
         actor_cls = ray_tpu.remote(**opts)(ServeReplica)
         return actor_cls.remote(dep.func_or_class, dep.init_args,
-                                dep.init_kwargs, deployment_name=dep.name)
+                                dep.init_kwargs, deployment_name=dep.name,
+                                max_ongoing=dep.max_ongoing_requests)
 
     def _stop_replicas(self, actors):
         import ray_tpu

@@ -40,6 +40,11 @@ class Deployment:
     # unconsumed chunk lead over a slow client (None = routed default, 16).
     # Propagates through the routing table; handle.options() can override.
     stream_backpressure_window: Optional[int] = None
+    # admission control: router-side bound on requests queued beyond the
+    # replicas' combined max_ongoing_requests capacity; overflow sheds
+    # typed BackPressureError (HTTP 503 + Retry-After at the proxy).
+    # None = _config.serve_max_queued_requests. Routing-table propagated.
+    max_queued_requests: Optional[int] = None
 
     def options(self, **kwargs) -> "Deployment":
         return replace(self, **kwargs)
@@ -79,6 +84,7 @@ def deployment(
     route_prefix: Optional[str] = None,
     request_timeout_s: Optional[float] = None,
     stream_backpressure_window: Optional[int] = None,
+    max_queued_requests: Optional[int] = None,
 ):
     """@serve.deployment — wraps a class or function into a Deployment."""
 
@@ -97,6 +103,7 @@ def deployment(
             route_prefix=route_prefix,
             request_timeout_s=request_timeout_s,
             stream_backpressure_window=stream_backpressure_window,
+            max_queued_requests=max_queued_requests,
         )
 
     if _func_or_class is not None:

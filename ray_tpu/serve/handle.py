@@ -78,6 +78,27 @@ class _ServeMetrics:
             "router-local in-flight requests across the deployment's "
             "replicas", tag_keys=dep,
         )
+        # ---- overload protection (PR 10) ----
+        self.shed = m.Counter(
+            "serve_shed_total",
+            "requests rejected by admission control (queue bound, replica "
+            "max_ongoing_requests, or every breaker open)", tag_keys=dep,
+        )
+        self.deadline_expired = m.Counter(
+            "serve_deadline_expired_total",
+            "requests shed because their deadline expired before dispatch",
+            tag_keys=dep,
+        )
+        self.budget_exhausted = m.Counter(
+            "serve_retry_budget_exhausted_total",
+            "failover retries suppressed by an empty retry token bucket",
+            tag_keys=dep,
+        )
+        self.circuit_open = m.Gauge(
+            "serve_circuit_open",
+            "replicas currently ejected by an open circuit breaker",
+            tag_keys=dep,
+        )
 
 
 _serve_metrics_inst: Optional[_ServeMetrics] = None
@@ -94,6 +115,22 @@ def serve_metrics() -> Optional[_ServeMetrics]:
     return _serve_metrics_inst
 
 
+class _Breaker:
+    """Per-replica circuit breaker (router-local). Consecutive replica-level
+    failures (death, unavailability, timeouts, slow calls) OPEN it; the
+    replica is ejected from routing for ``serve_circuit_cooldown_s``, then
+    exactly one HALF-OPEN probe request is let through — success closes the
+    breaker, failure re-opens it for another cooldown."""
+
+    __slots__ = ("state", "failures", "opened_at", "probe_inflight")
+
+    def __init__(self):
+        self.state = "closed"       # closed | open | half_open
+        self.failures = 0
+        self.opened_at = 0.0
+        self.probe_inflight = False
+
+
 class Router:
     def __init__(self, controller_handle):
         self._controller = controller_handle
@@ -103,10 +140,18 @@ class Router:
         self._timeouts: Dict[str, float] = {}  # per-deployment request timeout
         # per-deployment stream backpressure window (routing-table propagated)
         self._backpressures: Dict[str, int] = {}
+        # per-deployment admission bounds (routing-table propagated)
+        self._max_ongoing: Dict[str, int] = {}
+        self._max_queued: Dict[str, int] = {}
         # dep → replica-id bytes → in-flight count (keyed by stable
         # replica identity, NOT list position: eviction reshuffles indices)
         self._inflight: Dict[str, Dict[bytes, int]] = {}
         self._lock = threading.Lock()
+        # capacity plane: requests beyond replicas x max_ongoing wait HERE
+        # (router-side queue, the reference's pending_requests), woken by
+        # completions; the queue depth is bounded by max_queued_requests
+        self._capacity_cv = threading.Condition(self._lock)
+        self._queued: Dict[str, int] = {}
         self._last_refresh = 0.0
         # failover plane: dead-replica retries run on a dedicated thread
         # (future callbacks fire on arbitrary threads — resubmission must
@@ -114,6 +159,191 @@ class Router:
         self.retry_count = 0
         self._retry_queue: "_queue.Queue" = _queue.Queue()
         self._retry_thread: Optional[threading.Thread] = None
+        # overload protection: per-(deployment, replica) circuit breakers,
+        # per-deployment retry token buckets, shared backoff policy — all
+        # router-local (each client bounds its own retry pressure, the
+        # SRE retry-budget model)
+        self._breakers: Dict[tuple, _Breaker] = {}
+        self._budgets: Dict[str, Any] = {}
+        self._backoff = None
+
+    # ------------------------------------------------ retry budget + backoff
+    def _budget(self, deployment: str):
+        from ray_tpu.util.backoff import RetryBudget
+
+        b = self._budgets.get(deployment)
+        if b is None:
+            b = self._budgets[deployment] = RetryBudget()
+        return b
+
+    def retry_backoff(self):
+        from ray_tpu.util.backoff import BackoffPolicy
+
+        if self._backoff is None:
+            self._backoff = BackoffPolicy()
+        return self._backoff
+
+    def spend_retry_token(self, deployment: str) -> bool:
+        """One failover/recompile retry wants to run: True if the
+        deployment's token bucket covers it. All retry paths — routed
+        failover, streaming dispatch failover, compiled-handle recompiles —
+        draw from this one bucket, so their SUM is bounded by
+        serve_retry_budget_ratio x request volume and a dying fleet cannot
+        trigger a retry storm."""
+        if self._budget(deployment).try_spend(1.0):
+            return True
+        sm = serve_metrics()
+        if sm is not None:
+            sm.budget_exhausted.inc(1.0, {"deployment": deployment})
+        logger.warning(
+            "serve: retry budget exhausted for %r — surfacing the failure "
+            "instead of retrying", deployment,
+        )
+        return False
+
+    def _budget_error(self, deployment: str,
+                      cause: BaseException) -> exc.RetryBudgetExhaustedError:
+        err = exc.RetryBudgetExhaustedError(
+            f"deployment {deployment!r}: retry budget exhausted "
+            f"(original failure: {cause!r})"
+        )
+        err.__cause__ = cause
+        return err
+
+    # ------------------------------------------------------ deadline minting
+    def request_deadline(self, deployment: str,
+                         timeout: Optional[float] = None) -> float:
+        """Absolute deadline for one request: now + the effective timeout,
+        tightened by any deadline already active on this thread (a nested
+        deployment call never outlives its root request's budget)."""
+        timeout = timeout if timeout is not None else self.timeout_for(deployment)
+        deadline = time.time() + timeout
+        active = tracing.current_deadline()
+        return min(deadline, active) if active is not None else deadline
+
+    def _shed_expired(self, deployment: str, deadline: Optional[float],
+                      sm, tags, t0) -> None:
+        """Raise typed (and count) when the request's deadline has already
+        passed — BEFORE any replica work happens."""
+        if deadline is None or time.time() < deadline:
+            return
+        if sm is not None:
+            sm.deadline_expired.inc(1.0, tags)
+        self._observe_error(sm, tags, t0)
+        raise exc.DeadlineExceededError(
+            f"request to {deployment!r} shed before dispatch: deadline "
+            f"exceeded by {time.time() - deadline:.3f}s"
+        )
+
+    # ------------------------------------------------------ circuit breaking
+    def _breaker_admits(self, b: _Breaker, now: float) -> bool:
+        """Called under self._lock. open → ejected until the cooldown ends;
+        then half-open with room for ONE probe."""
+        if b.state == "closed":
+            return True
+        if b.state == "open":
+            if now - b.opened_at < _config.serve_circuit_cooldown_s:
+                return False
+            b.state = "half_open"
+            b.probe_inflight = False
+        return not b.probe_inflight  # half_open: one probe at a time
+
+    def record_replica_outcome(self, deployment: str, rkey: bytes,
+                               ok: bool, latency_ms: float = 0.0,
+                               dispatched_at: Optional[float] = None) -> None:
+        """Feed one completed dispatch into the replica's breaker. `ok`
+        means the REPLICA held up its end — user exceptions count as
+        success (the replica worked); replica death/unavailability/timeouts
+        and slow calls (serve_circuit_slow_call_ms, measured from DISPATCH,
+        never including router queue wait) count as failures. Breaking on
+        user errors or backpressure would amplify overload by shrinking
+        capacity exactly when it is scarcest.
+
+        ``dispatched_at`` (time.monotonic() at dispatch) lets an open/
+        half-open breaker ignore STALE results — a long request dispatched
+        before the ejection must neither close the breaker without a real
+        probe nor extend the cooldown."""
+        slow_ms = _config.serve_circuit_slow_call_ms
+        if ok and slow_ms > 0 and latency_ms > slow_ms:
+            ok = False
+        transition = None
+        with self._lock:
+            b = self._breakers.get((deployment, rkey))
+            if b is None:
+                if ok:
+                    return
+                b = self._breakers[(deployment, rkey)] = _Breaker()
+            if b.state in ("open", "half_open") and dispatched_at is not None \
+                    and dispatched_at < b.opened_at:
+                # dispatched before this ejection: not the probe, no vote
+                return
+            if b.state == "half_open":
+                b.probe_inflight = False
+            if ok and b.state == "open":
+                # stale result from a dispatch that predates the ejection:
+                # the cooldown holds — only a half-open probe closes us
+                return
+            if ok:
+                b.failures = 0
+                if b.state != "closed":
+                    b.state = "closed"
+                    transition = "closed"
+            else:
+                b.failures += 1
+                reopen = b.state == "half_open"  # failed probe: straight back
+                if reopen or (
+                    b.state == "closed"
+                    and b.failures >= _config.serve_circuit_failure_threshold
+                ):
+                    b.state = "open"
+                    b.opened_at = time.monotonic()
+                    b.probe_inflight = False
+                    transition = "open"
+        if transition is not None:
+            self._on_breaker_transition(deployment, rkey, transition)
+
+    def _on_breaker_transition(self, deployment: str, rkey: bytes,
+                               state: str) -> None:
+        logger.warning(
+            "serve: circuit %s for a replica of %r", state.upper(), deployment
+        )
+        self._update_circuit_gauge(deployment)
+        try:  # best effort: the controller records it for operators
+            self._controller.report_replica_state.remote(
+                deployment, rkey, state
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+
+    def _update_circuit_gauge(self, deployment: str) -> None:
+        sm = serve_metrics()
+        if sm is None:
+            return
+        with self._lock:
+            n = sum(
+                1 for (dep, _), b in self._breakers.items()
+                if dep == deployment and b.state == "open"
+            )
+        sm.circuit_open.set(n, {"deployment": deployment})
+
+    def circuit_state(self, deployment: str, rkey: bytes) -> str:
+        with self._lock:
+            b = self._breakers.get((deployment, rkey))
+            return b.state if b is not None else "closed"
+
+    # ----------------------------------------------------- admission control
+    def max_queued_for(self, deployment: str) -> int:
+        if deployment not in self._max_queued:
+            self._refresh()
+        return (
+            self._max_queued.get(deployment)
+            or _config.serve_max_queued_requests
+        )
+
+    def max_ongoing_for(self, deployment: str) -> int:
+        if deployment not in self._max_ongoing:
+            self._refresh()
+        return self._max_ongoing.get(deployment, 0)
 
     def _refresh(self, force: bool = False) -> None:
         import ray_tpu
@@ -139,6 +369,15 @@ class Router:
                 k: v for k, v in (table.get("stream_backpressure") or {}).items()
                 if v is not None
             }
+            self._max_ongoing = {
+                k: v for k, v in (table.get("max_ongoing") or {}).items()
+                if v is not None
+            }
+            self._max_queued = {
+                k: v for k, v in (table.get("max_queued") or {}).items()
+                if v is not None
+            }
+            live_keys = set()
             for name, replicas in self._replicas.items():
                 old = self._inflight.get(name, {})
                 # carry live counts across refreshes; drop dead replicas'
@@ -146,6 +385,14 @@ class Router:
                     r._actor_id.binary(): old.get(r._actor_id.binary(), 0)
                     for r in replicas
                 }
+                live_keys.update((name, k) for k in self._inflight[name])
+            # breakers of replaced/dead replicas go with them
+            pruned = [k for k in self._breakers if k not in live_keys]
+            for bk in pruned:
+                self._breakers.pop(bk, None)
+            self._capacity_cv.notify_all()  # fresh replicas: wake waiters
+        for dep in {d for d, _ in pruned}:
+            self._update_circuit_gauge(dep)  # a popped OPEN breaker un-gauges
 
     def deployment_for_route(self, path: str) -> Optional[str]:
         self._refresh()
@@ -166,11 +413,19 @@ class Router:
             self._refresh()
         return self._backpressures.get(deployment) or DEFAULT_STREAM_BACKPRESSURE
 
-    def assign_request(self, deployment: str, *args, **kwargs):
+    def assign_request(self, deployment: str, *args,
+                       _timeout_s: Optional[float] = None, **kwargs):
         """Route one request; returns an ObjectRef. When the backend
         supports deferred refs, the returned ref is fulfilled by a retry
-        chain: a replica death resolves it with the RETRIED result (one
-        retry on a healthy replica) instead of ActorDiedError."""
+        chain: a replica death resolves it with a RETRIED result (budget
+        permitting, on a healthy replica) instead of ActorDiedError.
+        ``_timeout_s`` is the hop's timeout override (underscore-named so
+        it can never collide with a deployment's own kwargs).
+
+        Overload protection: a deadline minted here (request_timeout_s /
+        handle timeout, tightened by any active deadline) rides the task
+        context into the replica and every nested call; an expired or
+        over-queue request sheds typed before any replica sees it."""
         from ray_tpu.api import _global_worker
 
         # tracing: one trace id per request (kept when the caller — e.g. an
@@ -189,10 +444,14 @@ class Router:
                 # counted on ARRIVAL: a deployment with zero live replicas
                 # must still show QPS + errors (the outage is the point)
                 sm.requests.inc(1.0, tags)
+            deadline = self.request_deadline(deployment, _timeout_s)
+            self._budget(deployment).note_request()
+            self._shed_expired(deployment, deadline, sm, tags, t0)
             try:
-                ref, replica = self.assign_request_with_replica(
-                    deployment, *args, **kwargs
-                )
+                with tracing.deadline_context(deadline):
+                    ref, replica = self.assign_request_with_replica(
+                        deployment, *args, _deadline=deadline, **kwargs
+                    )
             except BaseException:
                 self._observe_error(sm, tags, t0)
                 raise
@@ -208,7 +467,8 @@ class Router:
             out_ref, fulfill = deferred
             fulfill = self._timed_fulfill(sm, deployment, t0, fulfill)
             self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
-                               attempt=0, trace_id=trace_id)
+                               attempt=0, trace_id=trace_id,
+                               deadline=deadline)
             return out_ref
 
     # --------------------------------------------------------- SLO metrics
@@ -256,7 +516,8 @@ class Router:
 
     # ------------------------------------------------------------- failover
     def _arm_failover(self, deployment, ref, replica, args, kwargs, fulfill,
-                      attempt: int, trace_id: Optional[str] = None):
+                      attempt: int, trace_id: Optional[str] = None,
+                      deadline: Optional[float] = None):
         from ray_tpu.api import _global_worker
 
         # success-path passthrough: when the backend can hand us the
@@ -271,13 +532,27 @@ class Router:
                 value = fut.result()
             except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
                 self._on_replica_failure(deployment, replica)
-                if attempt < _config.serve_request_retries:
+                if attempt >= _config.serve_request_retries:
+                    fulfill(error=e)
+                elif deadline is not None and time.time() >= deadline:
+                    # the client stopped waiting: a retry would burn a
+                    # healthy replica for nobody
+                    sm = serve_metrics()
+                    if sm is not None:
+                        sm.deadline_expired.inc(
+                            1.0, {"deployment": deployment}
+                        )
+                    fulfill(error=exc.DeadlineExceededError(
+                        f"request to {deployment!r} not retried: deadline "
+                        "expired during the failed attempt"
+                    ))
+                elif not self.spend_retry_token(deployment):
+                    fulfill(error=self._budget_error(deployment, e))
+                else:
                     self._enqueue_retry(
                         deployment, args, kwargs, fulfill, attempt + 1,
-                        trace_id,
+                        trace_id, deadline,
                     )
-                else:
-                    fulfill(error=e)
                 return
             except BaseException as e:  # noqa: BLE001 - user exception
                 fulfill(error=e)
@@ -294,7 +569,7 @@ class Router:
             fulfill(error=e)
 
     def _enqueue_retry(self, deployment, args, kwargs, fulfill, attempt,
-                       trace_id=None):
+                       trace_id=None, deadline=None):
         with self._lock:
             if self._retry_thread is None:
                 self._retry_thread = threading.Thread(
@@ -303,30 +578,35 @@ class Router:
                 )
                 self._retry_thread.start()
         self._retry_queue.put(
-            (deployment, args, kwargs, fulfill, attempt, trace_id)
+            (deployment, args, kwargs, fulfill, attempt, trace_id, deadline)
         )
 
     def _retry_worker(self):
         while True:
-            (deployment, args, kwargs, fulfill, attempt,
-             trace_id) = self._retry_queue.get()
+            (deployment, args, kwargs, fulfill, attempt, trace_id,
+             deadline) = self._retry_queue.get()
             self.retry_count += 1
             logger.warning(
                 "serve: retrying request to %r on a healthy replica "
                 "(attempt %d)", deployment, attempt,
             )
+            # exponential backoff + jitter before re-dispatching: spreads a
+            # correlated failure's retries instead of stampeding the
+            # surviving replicas (budget was already spent by the enqueuer)
+            time.sleep(self.retry_backoff().delay(attempt))
             try:
                 # the retry dispatch keeps riding the original request's
                 # trace (the retry thread has no inherited context)
                 with tracing.trace_context(trace_id or tracing.new_trace_id()):
-                    ref, replica = self.assign_request_with_replica(
-                        deployment, *args, **kwargs
-                    )
+                    with tracing.deadline_context(deadline):
+                        ref, replica = self.assign_request_with_replica(
+                            deployment, *args, _deadline=deadline, **kwargs
+                        )
             except BaseException as e:  # noqa: BLE001 - no replicas left
                 fulfill(error=e)
                 continue
             self._arm_failover(deployment, ref, replica, args, kwargs,
-                               fulfill, attempt, trace_id)
+                               fulfill, attempt, trace_id, deadline)
 
     def _on_replica_failure(self, deployment: str, replica) -> None:
         """Evict a dead replica from the local routing set NOW (the next
@@ -342,10 +622,13 @@ class Router:
                 counts = self._inflight.get(deployment)
                 if counts is not None:
                     counts.pop(key, None)  # other replicas' counts survive
+                self._breakers.pop((deployment, key), None)
+                self._capacity_cv.notify_all()  # waiters re-read the fleet
                 logger.warning(
                     "serve: evicted dead replica of %r (%d left)",
                     deployment, len(kept),
                 )
+        self._update_circuit_gauge(deployment)  # popped breaker may be open
         sm = serve_metrics()
         if sm is not None:
             sm.failovers.inc(1.0, {"deployment": deployment})
@@ -377,13 +660,16 @@ class Router:
             )
             if sm is not None:
                 sm.requests.inc(1.0, tags)
+            deadline = self.request_deadline(deployment, timeout)
+            self._budget(deployment).note_request()
             while True:
+                self._shed_expired(deployment, deadline, sm, tags, t0)
                 try:
                     ref, replica = self.assign_request_with_replica(
-                        deployment, *args, **kwargs
+                        deployment, *args, _deadline=deadline, **kwargs
                     )
                 except BaseException:
-                    # no live replicas: the outage must show as an error
+                    # shed / no live replicas: must show as an error
                     self._observe_error(sm, tags, t0)
                     raise
                 if sm is not None and attempt == 0:
@@ -395,52 +681,146 @@ class Router:
                             (time.perf_counter() - t0) * 1000, tags
                         )
                     return out
-                except (exc.ActorDiedError, exc.ActorUnavailableError):
+                except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
                     self._on_replica_failure(deployment, replica)
                     attempt += 1
                     if attempt > _config.serve_request_retries:
                         self._observe_error(sm, tags, t0)
                         raise
+                    if not self.spend_retry_token(deployment):
+                        self._observe_error(sm, tags, t0)
+                        raise self._budget_error(deployment, e) from e
                     self.retry_count += 1
+                    time.sleep(self.retry_backoff().delay(attempt))
                 except BaseException:
                     self._observe_error(sm, tags, t0)
                     raise
 
-    def wait_for_replicas(self, deployment: str, timeout: float = 30.0):
+    def wait_for_replicas(self, deployment: str, timeout: float = 30.0,
+                          deadline: Optional[float] = None):
         """Block until the deployment has live replicas; returns the list
-        (shared by request assignment and compiled-handle pinning)."""
+        (shared by request assignment and compiled-handle pinning). A
+        request deadline bounds the wait — a total outage fails typed
+        within the request's own budget, never a hidden 30s."""
         self._refresh()
-        deadline = time.monotonic() + timeout
+        wait_until = time.monotonic() + timeout
         while True:
             with self._lock:
                 replicas = list(self._replicas.get(deployment) or ())
             if replicas:
                 return replicas
-            if time.monotonic() > deadline:
+            if deadline is not None and time.time() >= deadline:
+                raise exc.DeadlineExceededError(
+                    f"request to {deployment!r} shed: deadline expired "
+                    "while waiting for live replicas"
+                )
+            if time.monotonic() > wait_until:
                 raise RuntimeError(
                     f"no replicas for deployment {deployment!r}"
                 )
             time.sleep(0.1)
             self._refresh(force=True)
 
-    def _pick_replica(self, deployment: str):
-        """Power-of-two-choices on local in-flight counts; bumps the chosen
-        replica's count. Returns (replica handle, replica key)."""
-        replicas = self.wait_for_replicas(deployment)
-        keys = [r._actor_id.binary() for r in replicas]
-        with self._lock:
+    def _pick_replica(self, deployment: str,
+                      deadline: Optional[float] = None):
+        """Admission control + circuit breaking + power-of-two-choices.
+
+        The router never sends a replica more than its
+        ``max_ongoing_requests``: requests beyond the fleet's combined
+        capacity wait HERE, in a router-side queue bounded by
+        ``max_queued_requests`` — joining a full queue sheds typed
+        ``BackPressureError`` immediately (the client backs off), and a
+        queued request whose deadline expires sheds typed too (its replica
+        time would be wasted). Open circuit breakers eject their replicas
+        from the candidate set (a cooled-down breaker admits one half-open
+        probe); every candidate open ⇒ shed typed — bounded, never a hang.
+        Returns (replica handle, replica key)."""
+        self.wait_for_replicas(deployment, deadline=deadline)
+        max_ongoing = self.max_ongoing_for(deployment)
+        max_queued = self.max_queued_for(deployment)
+        sm = serve_metrics()
+        tags = {"deployment": deployment}
+        t_start = time.monotonic()
+        with self._capacity_cv:
             counts = self._inflight.setdefault(deployment, {})
-            if len(replicas) == 1:
-                idx = 0
-            else:
-                a, b = random.sample(range(len(replicas)), 2)
-                idx = (
-                    a if counts.get(keys[a], 0) <= counts.get(keys[b], 0)
-                    else b
+            if max_ongoing > 0 \
+                    and self._queued.get(deployment, 0) >= max_queued:
+                if sm is not None:
+                    sm.shed.inc(1.0, tags)
+                raise exc.BackPressureError(
+                    f"deployment {deployment!r} over capacity: "
+                    f"{max_queued} requests already queued "
+                    f"(max_queued_requests) behind "
+                    f"{sum(counts.values())} in flight"
                 )
-            rkey = keys[idx]
-            counts[rkey] = counts.get(rkey, 0) + 1
-            total = sum(counts.values())
+            self._queued[deployment] = self._queued.get(deployment, 0) + 1
+            try:
+                while True:
+                    # re-read replicas each pass: evictions/refreshes while
+                    # we waited must not dispatch to a dead replica
+                    replicas = list(self._replicas.get(deployment) or ())
+                    keys = [r._actor_id.binary() for r in replicas]
+                    now = time.monotonic()
+                    if replicas:
+                        allowed = [
+                            i for i, k in enumerate(keys)
+                            if (brk := self._breakers.get((deployment, k)))
+                            is None or self._breaker_admits(brk, now)
+                        ]
+                        if not allowed and all(
+                            (b2 := self._breakers.get((deployment, k)))
+                            is not None and b2.state == "open"
+                            for k in keys
+                        ):
+                            if sm is not None:
+                                sm.shed.inc(1.0, tags)
+                            raise exc.BackPressureError(
+                                f"every replica of {deployment!r} is "
+                                "circuit-open (cooling down after "
+                                "consecutive failures)"
+                            )
+                        free = [
+                            i for i in allowed
+                            if max_ongoing <= 0
+                            or counts.get(keys[i], 0) < max_ongoing
+                        ]
+                        if free:
+                            if len(free) == 1:
+                                idx = free[0]
+                            else:
+                                a, b = random.sample(free, 2)
+                                idx = (
+                                    a if counts.get(keys[a], 0)
+                                    <= counts.get(keys[b], 0) else b
+                                )
+                            rkey = keys[idx]
+                            br = self._breakers.get((deployment, rkey))
+                            if br is not None and br.state == "half_open":
+                                br.probe_inflight = True  # THE probe
+                            counts[rkey] = counts.get(rkey, 0) + 1
+                            total = sum(counts.values())
+                            break
+                    if not replicas and time.monotonic() - t_start > 30.0:
+                        raise RuntimeError(
+                            f"no replicas for deployment {deployment!r}"
+                        )
+                    # no capacity (or a half-open cooldown pending): wait
+                    # for a completion/refresh, bounded by the deadline
+                    if deadline is not None:
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
+                            if sm is not None:
+                                sm.deadline_expired.inc(1.0, tags)
+                            raise exc.DeadlineExceededError(
+                                f"request to {deployment!r} shed: deadline "
+                                "expired while queued at the router "
+                                "(never dispatched to a replica)"
+                            )
+                        self._capacity_cv.wait(min(0.05, remaining))
+                    else:
+                        self._capacity_cv.wait(0.05)
+            finally:
+                self._queued[deployment] -= 1
         self._set_inflight_gauge(deployment, total)
         return replicas[idx], rkey
 
@@ -449,12 +829,16 @@ class Router:
         if sm is not None:
             sm.inflight.set(total, {"deployment": deployment})
 
-    def assign_request_with_replica(self, deployment: str, *args, **kwargs):
-        """Pick a replica and dispatch; returns (ObjectRef, replica handle)
-        — legacy-polling streaming keeps pulling chunks from the SAME
-        replica."""
-        replica, rkey = self._pick_replica(deployment)
-        ref = replica.handle_request.remote(*args, **kwargs)
+    def assign_request_with_replica(self, deployment: str, *args,
+                                    _deadline: Optional[float] = None,
+                                    **kwargs):
+        """Pick a replica (admission + breaker + p2c) and dispatch; returns
+        (ObjectRef, replica handle) — legacy-polling streaming keeps pulling
+        chunks from the SAME replica. ``_deadline`` bounds the replica wait
+        and rides the submission's task context into the replica."""
+        replica, rkey = self._pick_replica(deployment, deadline=_deadline)
+        with tracing.deadline_context(_deadline):
+            ref = replica.handle_request.remote(*args, **kwargs)
         self._track_completion(deployment, rkey, ref)
         return ref, replica
 
@@ -492,22 +876,36 @@ class Router:
             )
             if sm is not None:
                 sm.requests.inc(1.0, tags)
+            deadline = self.request_deadline(deployment, timeout)
+            self._budget(deployment).note_request()
             while True:
+                self._shed_expired(deployment, deadline, sm, tags, t0)
                 try:
-                    replica, rkey = self._pick_replica(deployment)
+                    replica, rkey = self._pick_replica(
+                        deployment, deadline=deadline
+                    )
                 except BaseException:
-                    # no live replicas: the outage must show as an error
+                    # shed / no live replicas: must show as an error
                     self._observe_error(sm, tags, t0)
                     raise
                 if sm is not None and attempt == 0:
                     sm.queue.observe((time.perf_counter() - t0) * 1000, tags)
-                gen = replica.handle_request_streaming.options(
-                    num_returns="streaming",
-                    generator_backpressure_num_objects=backpressure,
-                ).remote(*args, **kwargs)
+                t_dispatch = time.monotonic()
+                with tracing.deadline_context(deadline):
+                    gen = replica.handle_request_streaming.options(
+                        num_returns="streaming",
+                        generator_backpressure_num_objects=backpressure,
+                    ).remote(*args, **kwargs)
                 try:
                     header = ray_tpu.get(gen.next_ref(timeout), timeout=timeout)
                     self._dec_inflight(deployment, rkey)
+                    # breaker latency is measured from DISPATCH: queue wait
+                    # and earlier attempts must not read as a slow replica
+                    self.record_replica_outcome(
+                        deployment, rkey, True,
+                        (time.monotonic() - t_dispatch) * 1000,
+                        dispatched_at=t_dispatch,
+                    )
                     if sm is not None:
                         # a stream's e2e is time-to-header: the dispatch +
                         # first-byte SLO (chunks then flow push-based)
@@ -515,16 +913,32 @@ class Router:
                             (time.perf_counter() - t0) * 1000, tags
                         )
                     return header, gen, replica
-                except (exc.ActorDiedError, exc.ActorUnavailableError):
+                except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
                     self._dec_inflight(deployment, rkey)
+                    self.record_replica_outcome(
+                        deployment, rkey, False, dispatched_at=t_dispatch
+                    )
                     self._on_replica_failure(deployment, replica)
                     attempt += 1
                     if attempt > _config.serve_request_retries:
                         self._observe_error(sm, tags, t0)
                         raise
+                    if not self.spend_retry_token(deployment):
+                        self._observe_error(sm, tags, t0)
+                        raise self._budget_error(deployment, e) from e
                     self.retry_count += 1
-                except BaseException:
+                    time.sleep(self.retry_backoff().delay(attempt))
+                except BaseException as e:
                     self._dec_inflight(deployment, rkey)
+                    # still a breaker vote: a header timeout is a slow/wedged
+                    # replica (failure); any other error means the replica
+                    # answered (success) — either way a half-open probe must
+                    # settle, or the replica would stay ejected forever
+                    self.record_replica_outcome(
+                        deployment, rkey,
+                        not isinstance(e, exc.GetTimeoutError),
+                        dispatched_at=t_dispatch,
+                    )
                     self._observe_error(sm, tags, t0)
                     raise
 
@@ -534,16 +948,36 @@ class Router:
             if counts and counts.get(rkey, 0) > 0:
                 counts[rkey] -= 1
             total = sum(counts.values()) if counts else 0
+            self._capacity_cv.notify_all()  # capacity freed: admit a waiter
         self._set_inflight_gauge(deployment, total)
 
     def _track_completion(self, deployment: str, rkey: bytes, ref) -> None:
-        def done(_):
+        t0 = time.monotonic()  # dispatch time (comparable to _Breaker clocks)
+
+        def done(fut):
             with self._lock:
                 counts = self._inflight.get(deployment)
                 if counts and counts.get(rkey, 0) > 0:
                     counts[rkey] -= 1
                 total = sum(counts.values()) if counts else 0
+                self._capacity_cv.notify_all()  # capacity freed
             self._set_inflight_gauge(deployment, total)
+            if fut is None:
+                return
+            # feed the replica's circuit breaker: replica-level failures
+            # and slow calls open it; user exceptions count as success
+            ok = True
+            try:
+                fut.result()
+            except (exc.ActorDiedError, exc.ActorUnavailableError,
+                    exc.GetTimeoutError):
+                ok = False
+            except BaseException:  # noqa: BLE001 - user error: replica works
+                pass
+            self.record_replica_outcome(
+                deployment, rkey, ok, (time.monotonic() - t0) * 1000,
+                dispatched_at=t0,
+            )
 
         try:
             ref.future().add_done_callback(done)
@@ -588,7 +1022,9 @@ class DeploymentHandle:
         return self._router.timeout_for(self.deployment_name)
 
     def remote(self, *args, **kwargs):
-        return self._router.assign_request(self.deployment_name, *args, **kwargs)
+        return self._router.assign_request(
+            self.deployment_name, *args, _timeout_s=self._timeout_s, **kwargs
+        )
 
     def compile(self, *, max_in_flight: int = 8) -> "CompiledDeploymentHandle":
         """Compiled fast path: pin ONE replica and stream requests through a
@@ -732,13 +1168,19 @@ class CompiledDeploymentHandle:
         already buffered."""
         from ray_tpu.cgraph import ChannelSeveredError
 
+        self._router._budget(self.deployment_name).note_request()
         dag = self._compiled
         try:
             ref = dag.execute(request, timeout=timeout)
         except (exc.ActorDiedError, exc.ActorUnavailableError,
-                ChannelSeveredError):
+                ChannelSeveredError) as e:
             # replica death OR a severed cross-node channel (the pinned
-            # replica may live on another host): both recompile
+            # replica may live on another host): both recompile — drawing
+            # from the SAME retry budget as routed failover, so recompile
+            # storms are bounded with everything else
+            if not self._router.spend_retry_token(self.deployment_name):
+                raise self._router._budget_error(self.deployment_name, e) \
+                    from e
             self._recover(dag)
             ref = self._compiled.execute(request, timeout=timeout)
         return _CompiledServeRef(self, request, ref)
@@ -768,9 +1210,14 @@ class _CompiledServeRef:
         try:
             return self._ref.get(timeout=timeout)
         except (exc.ActorDiedError, exc.ActorUnavailableError,
-                ChannelSeveredError):
+                ChannelSeveredError) as e:
             if self._retried:
                 raise
+            router = self._handle._router
+            if not router.spend_retry_token(self._handle.deployment_name):
+                raise router._budget_error(
+                    self._handle.deployment_name, e
+                ) from e
             self._retried = True
             dag = self._ref._dag
             self._handle._recover(dag)

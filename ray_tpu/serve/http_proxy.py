@@ -91,9 +91,10 @@ class HTTPProxy:
             if n:
                 body = await reader.readexactly(n)
 
-            status, payload = await asyncio.get_running_loop().run_in_executor(
-                None, self._dispatch, method, path, body
-            )
+            status, payload, extra = await asyncio.get_running_loop() \
+                .run_in_executor(
+                    None, self._dispatch, method, path, body, headers
+                )
             if status == "stream":
                 # chunked transfer: one JSON line per generator item, written
                 # the moment the replica pushes it (ray_tpu/streaming/ —
@@ -125,10 +126,13 @@ class HTTPProxy:
                 await writer.drain()
                 return
             data = json.dumps(payload, default=str).encode()
+            extra_lines = "".join(
+                f"{k}: {v}\r\n" for k, v in (extra or {}).items()
+            )
             writer.write(
                 f"HTTP/1.1 {status}\r\nContent-Type: application/json\r\n"
-                f"Content-Length: {len(data)}\r\nConnection: close\r\n\r\n"
-                .encode() + data
+                f"Content-Length: {len(data)}\r\n{extra_lines}"
+                f"Connection: close\r\n\r\n".encode() + data
             )
             await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -139,9 +143,12 @@ class HTTPProxy:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _dispatch(self, method: str, path: str, body: bytes):
+    def _dispatch(self, method: str, path: str, body: bytes,
+                  headers: Optional[dict] = None):
         t0 = time.perf_counter()
-        status, payload = self._dispatch_inner(method, path, body)
+        status, payload, extra = self._dispatch_inner(
+            method, path, body, headers or {}
+        )
         pm = _proxy_m()
         if pm is not None:
             # label cardinality is bounded by the ROUTING TABLE, never by
@@ -155,19 +162,21 @@ class HTTPProxy:
             code = "200" if status == "stream" else status.split()[0]
             counter.inc(1.0, {"route": route, "code": code})
             hist.observe((time.perf_counter() - t0) * 1000, {"route": route})
-        return status, payload
+        return status, payload, extra
 
-    def _dispatch_inner(self, method: str, path: str, body: bytes):
+    def _dispatch_inner(self, method: str, path: str, body: bytes,
+                        headers: dict):
         import ray_tpu
+        from ray_tpu import exceptions as exc
 
         # route on the path alone: /route?x=1 serves the /route deployment
         # (and the metrics label derives from the same stripped path)
         path = path.split("?", 1)[0]
         if path == "/-/healthz":
-            return "200 OK", {"status": "ok"}
+            return "200 OK", {"status": "ok"}, None
         name = self._router.deployment_for_route(path)
         if name is None:
-            return "404 Not Found", {"error": f"no route {path}"}
+            return "404 Not Found", {"error": f"no route {path}"}, None
         args = ()
         if body:
             try:
@@ -179,18 +188,47 @@ class HTTPProxy:
             # header costs one retry on a healthy replica, not a 500; the
             # header tells us whether to stream chunked or reply once
             timeout = self._router.timeout_for(name)
+            # client deadline header: the caller's own budget tightens the
+            # deployment timeout (never extends it) — the shed point for a
+            # client that will give up sooner than request_timeout_s
+            client_t = headers.get("x-request-timeout-s")
+            if client_t:
+                try:
+                    timeout = min(timeout, max(0.0, float(client_t)))
+                except ValueError:
+                    pass
             header, gen, _replica = self._router.stream_request(
                 name, args, timeout=timeout
             )
             if isinstance(header, dict) and header.get("streaming"):
-                return "stream", (gen, timeout)
+                return "stream", (gen, timeout), None
             result = self._next_push_chunk(gen, timeout)
             gen.close()
             if result is _STREAM_END:  # defensive: producer yielded nothing
-                return "200 OK", {"result": None}
-            return "200 OK", {"result": result}
+                return "200 OK", {"result": None}, None
+            return "200 OK", {"result": result}, None
+        except (exc.BackPressureError, exc.DeadlineExceededError,
+                exc.RetryBudgetExhaustedError) as e:
+            # overload protection: shed typed → 503 + Retry-After. The
+            # client should back off and retry; the error body says which
+            # protection fired (queue bound, expired deadline, breaker,
+            # or an empty retry budget).
+            return (
+                "503 Service Unavailable",
+                {"error": str(e), "type": type(e).__name__},
+                {"Retry-After": "1"},
+            )
+        except ray_tpu.exceptions.GetTimeoutError as e:
+            # the deadline expired while the request executed: the work is
+            # lost to this client, but the service is up — 503 so clients
+            # back off instead of treating it as a server bug
+            return (
+                "503 Service Unavailable",
+                {"error": str(e), "type": "GetTimeoutError"},
+                {"Retry-After": "1"},
+            )
         except Exception as e:  # noqa: BLE001 - surface as 500
-            return "500 Internal Server Error", {"error": str(e)}
+            return "500 Internal Server Error", {"error": str(e)}, None
 
     def _next_push_chunk(self, gen, timeout):
         """Blocking pull of the next pushed item's value (executor thread);

@@ -60,11 +60,17 @@ class _ReplicaMetrics:
             "requests executing in this replica right now",
             tag_keys=("deployment",),
         )
+        self.shed = m.Counter(
+            "serve_shed_total",
+            "requests this replica fast-rejected at max_ongoing_requests "
+            "(merges with the router-side series cluster-wide)",
+            tag_keys=("deployment",),
+        )
 
 
 class ServeReplica:
     def __init__(self, func_or_class, init_args, init_kwargs,
-                 deployment_name: str = ""):
+                 deployment_name: str = "", max_ongoing: int = 0):
         init_args = tuple(_resolve_bound(a) for a in init_args)
         init_kwargs = {k: _resolve_bound(v) for k, v in init_kwargs.items()}
         if inspect.isclass(func_or_class):
@@ -72,9 +78,14 @@ class ServeReplica:
         else:
             self._callable = func_or_class
         self._deployment_name = deployment_name
+        # enforced bound on concurrently-EXECUTING user requests (0 = off):
+        # the actor's max_concurrency leaves +2 headroom threads so health
+        # checks and this fast-reject never queue behind saturated work
+        self._max_ongoing = max_ongoing
         self._metrics: Any = None  # built lazily (config-gated)
         self._ongoing = 0
         self._total = 0
+        self._sheds = 0  # requests this replica rejected (tests/stats)
         self._streams: Dict[str, Tuple[Any, float]] = {}  # sid -> (gen, last_access)
         # sids reaped while undrained: a later next_chunk must raise, not
         # report a clean end-of-stream (silent truncation). Bounded FIFO.
@@ -97,6 +108,52 @@ class ServeReplica:
             self._metrics = _ReplicaMetrics(self._deployment_name)
         return self._metrics
 
+    def _admit(self):
+        """Replica-side admission (defense in depth behind the router's
+        queue bound — several routers can overcommit one replica): reject
+        typed once max_ongoing user requests are already executing, and
+        honor the chaos ``replica.slow`` injection point (deterministic
+        slow-replica scenarios for the circuit-breaker tests)."""
+        from ray_tpu.testing import chaos
+
+        act = chaos.fire("replica.handle", key=self._chaos_key())
+        if act is not None and act.get("action") == "delay":
+            time.sleep(act.get("delay_s") or 0.2)
+        if 0 < self._max_ongoing <= self._ongoing:
+            self._sheds += 1
+            m = self._m()
+            if m is not None:
+                m.shed.inc(1.0, m.tags)
+            from ray_tpu import exceptions as exc
+
+            raise exc.BackPressureError(
+                f"replica of {self._deployment_name!r} at "
+                f"max_ongoing_requests={self._max_ongoing}"
+            )
+
+    def _chaos_key(self) -> str:
+        """deployment:replica-identity — lets a chaos plan target ONE
+        replica (``slow_replica(match=<actor id hex>)``) even when every
+        replica runs the same code."""
+        actor_hex = ""
+        try:
+            from ray_tpu.api import _global_worker
+
+            worker = _global_worker()
+            agent = getattr(worker.backend, "core", None)
+            raw = getattr(agent, "actor_id", None)
+            if raw is not None:
+                actor_hex = raw.hex() if isinstance(raw, bytes) else str(raw)
+            else:  # local mode: the executing actor rides a thread-local
+                from ray_tpu.core.local_backend import _current_actor
+
+                aid = getattr(_current_actor, "actor_id", None)
+                if aid is not None:
+                    actor_hex = aid.hex()
+        except Exception:  # noqa: BLE001 - chaos keying is best-effort
+            pass
+        return f"{self._deployment_name}:{actor_hex}"
+
     def handle_request_streaming(self, *args, **kwargs):
         """Generator entry point for the push-based streaming path: called
         with ``num_returns="streaming"``, so every yield is pushed to the
@@ -106,6 +163,7 @@ class ServeReplica:
         generator response then streams its chunks, anything else yields the
         single result. A mid-chunk user exception surfaces on the exact item
         that raised (streaming-generator error semantics)."""
+        self._admit()
         self._ongoing += 1
         self._total += 1
         m = self._m()
@@ -159,6 +217,7 @@ class ServeReplica:
             self._reaped_set.add(sid)
 
     def handle_request(self, *args, **kwargs) -> Any:
+        self._admit()
         self._ongoing += 1
         self._total += 1
         m = self._m()
@@ -232,6 +291,7 @@ class ServeReplica:
             "ongoing": self._ongoing,
             "total": self._total,
             "legacy_polls": self._legacy_polls,
+            "sheds": self._sheds,
         }
 
     def check_health(self) -> bool:

@@ -34,6 +34,11 @@ point                 where it fires
                       Nth write on a cross-node compiled-graph channel
                       severs its stream connection (or is delayed), so
                       both endpoints observe a mid-stream transport loss
+``replica.handle``    ``serve/replica.py`` request entry (unary +
+                      streaming) — the matching replica's calls are
+                      delayed (``slow_replica``): deterministic
+                      slow/degraded-replica injection driving the serve
+                      circuit breaker
 ====================  ======================================================
 
 Usage (context-manager API)::
@@ -100,10 +105,26 @@ class ChaosPlan:
         """SIGKILL the worker granted the Nth task lease on a raylet."""
         return self._rule("worker.lease", "kill", nth=after_tasks)
 
-    def kill_actor(self, match: str = "", after_calls: int = 1) -> "ChaosPlan":
+    def kill_actor(self, match: str = "", after_calls: int = 1,
+                   repeat: bool = False, times: int = 0) -> "ChaosPlan":
         """Kill the actor process at the Nth call whose ``Class.method``
-        contains ``match`` (empty = any actor call)."""
-        return self._rule("actor.call", "kill", match=match, nth=after_calls)
+        contains ``match`` (empty = any actor call). ``repeat=True`` kills
+        at EVERY Nth matching call (a replica-kill storm — each controller
+        replacement dies again), bounded by ``times`` total firings
+        (0 = unbounded)."""
+        return self._rule("actor.call", "kill", match=match, nth=after_calls,
+                          repeat=repeat, times=times)
+
+    def slow_replica(self, match: str = "", delay_s: float = 0.3,
+                     nth: int = 1, times: int = 0) -> "ChaosPlan":
+        """Delay every Nth serve-replica request whose key
+        (``deployment:replica-actor-id-hex``) contains ``match`` by
+        ``delay_s`` — a deterministic slow/degraded replica. ``times``
+        bounds the total injections (0 = unbounded): the replica "recovers"
+        after that many slow calls, so circuit-breaker tests can assert
+        the half-open probe restores it."""
+        return self._rule("replica.handle", "delay", match=match, nth=nth,
+                          repeat=True, times=times, delay_s=delay_s)
 
     def kill_cgraph_actor(self, match: str = "",
                           after_iters: int = 1) -> "ChaosPlan":
@@ -231,6 +252,9 @@ class _Runtime:
                     continue
                 if self.fired[i] and not r.get("repeat"):
                     continue  # one-shot rule already spent
+                if r.get("repeat") and r.get("times") \
+                        and self.fired[i] >= r["times"]:
+                    continue  # bounded-repeat rule exhausted ("recovered")
                 self.counters[i] += 1
                 nth = r.get("nth", 1)
                 # one-shot uses >= so a rule whose trigger event was consumed

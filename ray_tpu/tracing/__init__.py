@@ -30,14 +30,17 @@ from ray_tpu.tracing.events import (
     LIFECYCLE_STATES,
     TERMINAL_STATES,
     TaskEventBuffer,
+    current_deadline,
     current_job_id,
     current_task_id,
     current_trace_id,
+    deadline_context,
     ensure_trace,
     get_buffer,
     new_trace_id,
     profile_span,
     read_wal,
+    remaining_time_s,
     task_context,
     trace_context,
 )
@@ -50,9 +53,12 @@ __all__ = [
     "TaskEventBuffer",
     "TaskEventAggregator",
     "build_chrome_trace",
+    "current_deadline",
     "current_job_id",
     "current_task_id",
     "current_trace_id",
+    "deadline_context",
+    "remaining_time_s",
     "ensure_trace",
     "get_buffer",
     "new_trace_id",

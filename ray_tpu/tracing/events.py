@@ -66,26 +66,63 @@ def current_job_id() -> Optional[str]:
     return getattr(_ctx, "job_id", None)
 
 
+def current_deadline() -> Optional[float]:
+    """Absolute wall-clock deadline (time.time() epoch seconds) of the
+    request executing on this thread, or None when none is set."""
+    return getattr(_ctx, "deadline", None)
+
+
+def remaining_time_s() -> Optional[float]:
+    """Seconds left until the current request's deadline (may be <= 0 once
+    expired), or None when no deadline is active. User code running inside
+    a deadline-carrying task can cooperate: checkpoint, return a partial
+    result, or stop early instead of burning time nobody will wait for."""
+    d = getattr(_ctx, "deadline", None)
+    if d is None:
+        return None
+    return d - time.time()
+
+
 def new_trace_id() -> str:
     return uuid.uuid4().hex
 
 
 @contextlib.contextmanager
 def task_context(task_id: Optional[str], trace_id: Optional[str],
-                 job_id: Optional[str] = None):
+                 job_id: Optional[str] = None,
+                 deadline: Optional[float] = None):
     """Execute a task frame: nested submissions see this task as parent,
-    ride the same trace, and inherit the job (per-job retention)."""
+    ride the same trace, inherit the job (per-job retention), and carry
+    the request deadline (overload protection: nested calls never outlive
+    their root request's budget)."""
     prev = (getattr(_ctx, "task_id", None), getattr(_ctx, "trace_id", None),
-            getattr(_ctx, "job_id", None))
+            getattr(_ctx, "job_id", None), getattr(_ctx, "deadline", None))
     _ctx.task_id = task_id
     if trace_id is not None:
         _ctx.trace_id = trace_id
     if job_id is not None:
         _ctx.job_id = job_id
+    if deadline is not None:
+        _ctx.deadline = deadline
     try:
         yield
     finally:
-        _ctx.task_id, _ctx.trace_id, _ctx.job_id = prev
+        (_ctx.task_id, _ctx.trace_id, _ctx.job_id, _ctx.deadline) = prev
+
+
+@contextlib.contextmanager
+def deadline_context(deadline: Optional[float]):
+    """Pin an absolute request deadline on the current thread. The
+    EARLIER of `deadline` and any already-active deadline wins — a nested
+    deployment call can tighten its parent's budget, never extend it."""
+    prev = getattr(_ctx, "deadline", None)
+    if deadline is not None and prev is not None:
+        deadline = min(deadline, prev)
+    _ctx.deadline = deadline if deadline is not None else prev
+    try:
+        yield _ctx.deadline
+    finally:
+        _ctx.deadline = prev
 
 
 @contextlib.contextmanager

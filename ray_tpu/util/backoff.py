@@ -1,0 +1,120 @@
+"""Shared retry policy: exponential backoff with jitter + token-bucket
+retry budgets.
+
+Every system-failure retry path (core task resubmits, lineage
+reconstruction, actor-call replays, serve failover, compiled-handle
+recompiles) draws its delays from one :class:`BackoffPolicy` so an outage
+produces spread-out, bounded retry pressure instead of a synchronized
+storm. Serve additionally gates each retry on a per-deployment
+:class:`RetryBudget` (SRE-style: retries are a bounded fraction of request
+volume), so failover cannot amplify an overload.
+
+Determinism: under an active chaos plan (``ray_tpu.testing.chaos``),
+:func:`seeded_rng` derives the jitter RNG from the plan seed — a chaos run
+replays the exact same delay sequence, so a shed/retry interleaving found
+once reproduces from ``(plan, seed)``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from ray_tpu.core.config import _config
+
+
+def seeded_rng() -> random.Random:
+    """A fresh RNG: seeded from the active chaos plan (deterministic
+    replay) or OS entropy otherwise."""
+    try:
+        from ray_tpu.testing import chaos
+
+        rt = chaos.active()
+        if rt is not None:
+            return random.Random(rt.plan.seed)
+    except Exception:  # noqa: BLE001 - chaos must never break retries
+        pass
+    return random.Random()
+
+
+class BackoffPolicy:
+    """delay(n) = min(max, base * multiplier^(n-1)) * (1 ± jitter).
+
+    ``attempt`` is 1-based (the delay before the first retry). Defaults
+    come from the config's ``retry_backoff_*`` knobs; ``base_s`` can be
+    overridden per call site (e.g. the actor path keeps its historical
+    ``actor_restart_backoff_s`` base)."""
+
+    def __init__(self, base_s: Optional[float] = None,
+                 multiplier: Optional[float] = None,
+                 max_s: Optional[float] = None,
+                 jitter: Optional[float] = None,
+                 rng: Optional[random.Random] = None):
+        self.base_s = (
+            base_s if base_s is not None
+            else _config.retry_backoff_base_ms / 1000.0
+        )
+        self.multiplier = (
+            multiplier if multiplier is not None
+            else _config.retry_backoff_multiplier
+        )
+        self.max_s = (
+            max_s if max_s is not None
+            else _config.retry_backoff_max_ms / 1000.0
+        )
+        self.jitter = (
+            jitter if jitter is not None else _config.retry_backoff_jitter
+        )
+        self._rng = rng or seeded_rng()
+
+    def delay(self, attempt: int) -> float:
+        """Seconds to sleep before retry #attempt (>= 1)."""
+        n = max(1, int(attempt))
+        d = min(self.max_s, self.base_s * self.multiplier ** (n - 1))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+
+class RetryBudget:
+    """Token bucket bounding retries to a fraction of request volume.
+
+    Each request deposits ``ratio`` tokens (capped at ``burst``); each
+    retry spends one. The bucket STARTS at ``min_tokens`` (a cold-start
+    grant — a quiet deployment can still fail over a few times before any
+    traffic has deposited), after which the budget is strictly
+    rate-based: a deployment seeing 100 req/s with ratio 0.1 sustains
+    ~10 retries/s; one seeing 1 req/min earns a retry every ~10 minutes."""
+
+    def __init__(self, ratio: Optional[float] = None,
+                 min_tokens: Optional[float] = None,
+                 burst: Optional[float] = None):
+        self.ratio = (
+            ratio if ratio is not None else _config.serve_retry_budget_ratio
+        )
+        self.min_tokens = (
+            min_tokens if min_tokens is not None
+            else _config.serve_retry_budget_min_tokens
+        )
+        self.burst = max(
+            self.min_tokens,
+            burst if burst is not None else _config.serve_retry_budget_burst,
+        )
+        self._tokens = self.min_tokens
+        self._lock = threading.Lock()
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+    def note_request(self) -> None:
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def try_spend(self, n: float = 1.0) -> bool:
+        with self._lock:
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False

@@ -415,3 +415,27 @@ def window_percentile(samples: List[dict], name: str, q: float,
         if sum(delta) > 0:
             counts = delta
     return histogram_percentile(boundaries, counts, q)
+
+
+# ------------------------------------------------- overload-protection series
+_deadline_expired: Optional["Counter"] = None
+
+
+def deadline_expired_counter() -> Optional["Counter"]:
+    """``task_deadline_expired_total``: work shed because its request
+    deadline expired before dispatch (owner side) or before execution
+    (worker side). Recorded by the core planes — the serve layer keeps its
+    own deployment-tagged ``serve_deadline_expired_total``. None when the
+    built-in instrumentation is off."""
+    from ray_tpu.core.config import _config
+
+    global _deadline_expired
+    if not _config.metrics_enabled:
+        return None
+    if _deadline_expired is None:
+        _deadline_expired = Counter(
+            "task_deadline_expired_total",
+            "tasks shed pre-dispatch/pre-execution on an expired deadline",
+            tag_keys=("where",),
+        )
+    return _deadline_expired
