@@ -99,6 +99,7 @@ class ActorHandle:
                 from ray_tpu.api import _global_worker, is_initialized
 
                 if is_initialized():
+                    # raylint: disable=RT004(free_actor is fire-and-forget by design — kill_actor(wait=False) never blocks on the loop; the PR-1 fix)
                     _global_worker().backend.free_actor(self._actor_id)
             except Exception:  # interpreter shutdown
                 pass

@@ -1,0 +1,39 @@
+"""raylint: project-specific concurrency/protocol static analysis.
+
+Every deadlock class this repo has shipped and later fixed — a ``__del__``
+blocking on the io-loop thread, a threading lock held across ``await``, a
+GC-able bare ``ensure_future`` task — is mechanically detectable from the
+source. This package is the CI gate that keeps them from coming back:
+
+- :mod:`ray_tpu.analysis.linter` — the AST linter framework (rule registry,
+  inline ``raylint: disable=RULE(reason)`` suppressions, committed
+  baseline for grandfathered findings outside the core planes).
+- :mod:`ray_tpu.analysis.rules` — the RT001–RT007 rules.
+- :mod:`ray_tpu.analysis.sanitizers` — dev-mode runtime sanitizers
+  (``RAY_TPU_SANITIZE=1``): lock-order cycle detection over the named
+  core-plane locks, an io-loop watchdog, thread-affinity assertions.
+- :mod:`ray_tpu.analysis.docs` — generated docs (the chaos-point table in
+  README) so prose can't drift from the registries the rules check.
+
+Run it: ``python -m ray_tpu.scripts lint [--json]`` (exit 0 = clean).
+
+The linter exports resolve lazily (PEP 562): production processes import
+this package on every ``import ray_tpu`` (the runtime planes pull in
+``sanitizers``), and the AST framework has no business in a worker's
+startup path.
+"""
+
+_LINT_EXPORTS = ("Finding", "LintResult", "lint_package", "lint_paths",
+                 "lint_source")
+
+
+def __getattr__(name):
+    if name in _LINT_EXPORTS:
+        from ray_tpu.analysis import linter
+
+        return getattr(linter, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LINT_EXPORTS))

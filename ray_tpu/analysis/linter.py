@@ -1,0 +1,361 @@
+"""The raylint framework: AST rule registry, suppressions, baseline.
+
+Role parity: Ray gates whole bug classes (TSan/ASan C++ CI jobs, custom
+flake8 plugins under ci/lint/) instead of hoping code review catches them.
+The Python planes here get the same treatment natively: each rule is an AST
+pass over one module (plus optional whole-project checks for registry-drift
+rules), findings are suppressible inline with a mandatory reason, and
+grandfathered findings outside the core planes live in a committed baseline
+file that new code cannot grow.
+
+Mechanics:
+
+- **Suppression**: a ``raylint: disable=RT001(reason)`` comment on the
+  finding line or the line directly above suppresses that rule there. A
+  suppression without a ``(reason)`` is itself a finding (``RT000``) —
+  silent opt-outs are the drift this tool exists to stop.
+- **Baseline**: ``raylint_baseline.json`` next to this module lists
+  grandfathered findings as ``{rule, path, line_text, reason}``. Matching
+  is by stripped source-line text, not line number, so unrelated edits
+  don't churn it. Baseline entries for the core planes (``core/``,
+  ``cgraph/``, ``serve/``, ``streaming/``, ``tracing/``) are rejected:
+  findings there must be fixed or justified inline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# trees where a finding must be fixed (or inline-suppressed with a reason),
+# never baselined: the load-bearing runtime planes
+CORE_PLANES = ("core/", "cgraph/", "serve/", "streaming/", "tracing/")
+
+# one suppression comment = a comma-list of rule ids sharing ONE trailing
+# (reason); per-rule reasons are not supported — write two comments. The
+# reason capture is greedy to the line's last ')' so justifications may
+# themselves contain parentheses (e.g. "kill_actor(wait=False)").
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*disable=(?P<rules>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"(?P<reason>\(.*\))?"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int            # 1-based
+    message: str
+    context: str = ""    # enclosing function/class qualname
+    line_text: str = ""  # stripped source of the finding line
+    suppressed: bool = False
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.line_text)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message, "context": self.context,
+            "line_text": self.line_text, "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+    def __str__(self) -> str:
+        where = f"{self.path}:{self.line}"
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{where}: {self.rule}{ctx}: {self.message}"
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)  # framework problems
+    files: int = 0
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    @property
+    def clean(self) -> bool:
+        return not self.unsuppressed and not self.errors
+
+    def to_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "clean": self.clean,
+            "errors": self.errors,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+class ModuleInfo:
+    """One parsed module: tree with parent links, source lines, suppressions."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._raylint_parent = parent  # type: ignore[attr-defined]
+        # line -> {rule: reason or None}; None reason = malformed suppression
+        self.suppressions: Dict[int, Dict[str, Optional[str]]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            reason = m.group("reason")
+            reason = reason[1:-1].strip() if reason else ""
+            for rule in re.split(r"\s*,\s*", m.group("rules")):
+                self.suppressions.setdefault(i, {})[rule] = reason or None
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_raylint_parent", None)
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(parts))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppression_for(self, lineno: int, rule: str) -> Optional[Tuple[int, Optional[str]]]:
+        """(suppression line, reason) covering ``rule`` at ``lineno`` —
+        the line itself or the line directly above — else None."""
+        for ln in (lineno, lineno - 1):
+            rules = self.suppressions.get(ln)
+            if rules is not None and rule in rules:
+                return ln, rules[rule]
+        return None
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        lineno = (node_or_line if isinstance(node_or_line, int)
+                  else node_or_line.lineno)
+        ctx = ("" if isinstance(node_or_line, int)
+               else self.qualname(node_or_line))
+        return Finding(
+            rule=rule, path=self.relpath, line=lineno, message=message,
+            context=ctx, line_text=self.line_text(lineno),
+        )
+
+
+def in_core_plane(relpath: str) -> bool:
+    rel = relpath.replace(os.sep, "/")
+    rel = rel.split("ray_tpu/", 1)[-1]
+    return any(rel.startswith(p) for p in CORE_PLANES)
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                             "raylint_baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Tuple[List[dict], List[str]]:
+    """(entries, errors). Every entry needs rule/path/line_text and a
+    non-empty one-line reason; core-plane entries are rejected."""
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return [], []
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [], [f"unreadable baseline {path}: {e}"]
+    entries, errors = [], []
+    for i, e in enumerate(raw if isinstance(raw, list) else []):
+        missing = [k for k in ("rule", "path", "line_text", "reason")
+                   if not str(e.get(k, "")).strip()]
+        if missing:
+            errors.append(f"baseline entry {i} missing {missing}: {e}")
+            continue
+        if "\n" in e["reason"]:
+            errors.append(f"baseline entry {i}: reason must be one line")
+            continue
+        if in_core_plane(e["path"]):
+            errors.append(
+                f"baseline entry {i} grandfathers a core-plane finding "
+                f"({e['rule']} in {e['path']}): fix it or suppress inline "
+                f"with a reason — core planes cannot be baselined"
+            )
+            continue
+        entries.append(e)
+    return entries, errors
+
+
+# --------------------------------------------------------------------------
+# Runner
+# --------------------------------------------------------------------------
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _relpath(path: str) -> str:
+    """Repo-relative path (ray_tpu/... or tests/...) for stable reporting."""
+    repo = os.path.dirname(_package_root())
+    ap = os.path.abspath(path)
+    if ap.startswith(repo + os.sep):
+        return os.path.relpath(ap, repo).replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def _load_rules():
+    from ray_tpu.analysis import rules as rules_mod
+
+    return rules_mod.all_rules()
+
+
+def lint_modules(modules: List[ModuleInfo],
+                 baseline_path: Optional[str] = None,
+                 project_checks: bool = True,
+                 check_stale_baseline: bool = True) -> LintResult:
+    result = LintResult(files=len(modules))
+    rules = _load_rules()
+    for mod in modules:
+        for rule in rules:
+            try:
+                result.findings.extend(rule.check(mod))
+            except Exception as e:  # noqa: BLE001 - one bad rule/file
+                result.errors.append(
+                    f"{rule.id} crashed on {mod.relpath}: {e!r}"
+                )
+    if project_checks:
+        for rule in rules:
+            try:
+                result.findings.extend(rule.project_check(modules))
+            except Exception as e:  # noqa: BLE001
+                result.errors.append(f"{rule.id} project check crashed: {e!r}")
+
+    # suppressions: mark findings covered by an inline disable; a disable
+    # with no reason converts into an RT000 finding instead of suppressing
+    by_path = {m.relpath: m for m in modules}
+    extra: List[Finding] = []
+    used: set = set()
+    for f in result.findings:
+        mod = by_path.get(f.path)
+        if mod is None:
+            continue
+        hit = mod.suppression_for(f.line, f.rule)
+        if hit is None:
+            continue
+        ln, reason = hit
+        used.add((f.path, ln, f.rule))
+        if reason is None:
+            extra.append(mod.finding(
+                "RT000", ln,
+                f"suppression of {f.rule} without a (reason) — every "
+                f"disable must say why",
+            ))
+        else:
+            f.suppressed = True
+    # unused suppressions are drift too: the finding they hid is gone
+    for mod in modules:
+        for ln, rules_at in mod.suppressions.items():
+            for rule in rules_at:
+                if rule == "RT000":
+                    continue
+                if (mod.relpath, ln, rule) not in used:
+                    extra.append(mod.finding(
+                        "RT000", ln,
+                        f"unused suppression of {rule}: nothing to "
+                        f"suppress here any more — remove it",
+                    ))
+    result.findings.extend(extra)
+
+    # baseline: grandfathered findings match on (rule, path, line text);
+    # baseline_path="" means "no baseline" (fixture tests)
+    entries, berrors = (([], []) if baseline_path == ""
+                        else load_baseline(baseline_path))
+    result.errors.extend(berrors)
+    matched: set = set()
+    index = {(e["rule"], e["path"], e["line_text"].strip()): i
+             for i, e in enumerate(entries)}
+    for f in result.findings:
+        if f.suppressed or f.rule == "RT000":
+            continue
+        i = index.get(f.key())
+        if i is not None:
+            f.baselined = True
+            matched.add(i)
+    # staleness is only decidable on a whole-package run: a partial lint
+    # simply didn't visit the entry's file
+    if check_stale_baseline:
+        for i, e in enumerate(entries):
+            if i not in matched:
+                result.errors.append(
+                    f"stale baseline entry ({e['rule']} in {e['path']}): "
+                    f"the finding no longer exists — remove it"
+                )
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+def lint_paths(paths: List[str],
+               baseline_path: Optional[str] = None,
+               check_stale_baseline: bool = False) -> LintResult:
+    """Lint specific files/dirs. Partial runs skip stale-baseline
+    detection (they didn't visit every baselined file)."""
+    modules: List[ModuleInfo] = []
+    result_errors: List[str] = []
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(_iter_py_files(p))
+        else:
+            files.append(p)
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(ModuleInfo(path, _relpath(path), src))
+        except (OSError, SyntaxError) as e:
+            result_errors.append(f"cannot parse {path}: {e}")
+    res = lint_modules(modules, baseline_path=baseline_path,
+                       check_stale_baseline=check_stale_baseline)
+    res.errors = result_errors + res.errors
+    return res
+
+
+def lint_package(baseline_path: Optional[str] = None) -> LintResult:
+    """Lint the whole installed ray_tpu package (the tier-1 gate)."""
+    return lint_paths([_package_root()], baseline_path=baseline_path,
+                      check_stale_baseline=True)
+
+
+def lint_source(source: str, filename: str = "snippet.py",
+                with_project_checks: bool = False) -> LintResult:
+    """Lint one source string (fixture tests). No baseline is applied."""
+    mod = ModuleInfo(filename, filename, source)
+    return lint_modules([mod], baseline_path="",
+                        project_checks=with_project_checks)

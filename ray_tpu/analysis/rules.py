@@ -1,0 +1,744 @@
+"""raylint rules RT001–RT007: the deadlock/drift classes this repo has
+actually shipped, made mechanically detectable.
+
+Each rule documents the incident class it guards (see ROADMAP/CHANGES for
+the PRs that fixed the originals). Rules are deliberately heuristic — they
+key on the codebase's own idioms (``self.io`` / ``core.io`` for the
+EventLoopThread, ``*_lock``/``*_cond`` naming for threading primitives) and
+lean on the suppression mechanism for the rare intentional exception,
+because a silent false negative costs a production deadlock while a false
+positive costs one ``# raylint: disable=...(reason)`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.linter import Finding, ModuleInfo
+
+# --------------------------------------------------------------------------
+# AST helpers
+# --------------------------------------------------------------------------
+
+def _walk_skip_nested(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested def/class bodies
+    (those run in their own context); lambdas are included."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _chain(node: ast.AST) -> List[str]:
+    """Dotted name parts of a Name/Attribute chain: ``self.core.io.run`` →
+    ["self", "core", "io", "run"]. Calls in the chain contribute their
+    func's chain (``a().b`` → ["a", "b"])."""
+    parts: List[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Call):
+            cur = cur.func
+        elif isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            break
+        else:
+            break
+    return list(reversed(parts))
+
+
+def _chain_text(node: ast.AST) -> str:
+    return ".".join(_chain(node))
+
+
+def _str_arg(call: ast.Call, index: int = 0) -> Optional[str]:
+    if len(call.args) > index and isinstance(call.args[index], ast.Constant) \
+            and isinstance(call.args[index].value, str):
+        return call.args[index].value
+    return None
+
+
+_IO_THREAD_NAMES = ("io", "_io", "io_thread", "_io_thread", "loop_thread",
+                    "_loop_thread", "event_loop_thread")
+
+
+def _is_io_thread_recv(recv_chain: List[str]) -> bool:
+    return bool(recv_chain) and recv_chain[-1] in _IO_THREAD_NAMES
+
+
+def _enclosing_class(mod: ModuleInfo, node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = mod.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None  # a def nested in a method belongs to no class
+        cur = mod.parent(cur)
+    return None
+
+
+# --------------------------------------------------------------------------
+# Loop-context discovery (shared by RT001)
+# --------------------------------------------------------------------------
+
+# receivers whose callback argument runs ON the event loop
+_LOOP_CB_METHODS = {"call_soon", "call_later", "call_soon_threadsafe",
+                    "call_batched", "add_done_callback"}
+
+_FuncNode = ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+
+
+class _ModuleGraph:
+    """Per-module call graph over same-class methods and same-module
+    functions, plus the set of functions that execute on an event loop
+    (async defs + callbacks handed to the loop, transitively through
+    direct sync calls)."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.by_bare_name: Dict[str, List[ast.AST]] = {}
+        self.by_class_method: Dict[Tuple[str, str], ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.by_bare_name.setdefault(node.name, []).append(node)
+                cls = _enclosing_class(mod, node)
+                if cls is not None:
+                    self.by_class_method[(cls.name, node.name)] = node
+
+    def _resolve_call(self, caller: ast.AST, call: ast.Call) -> List[ast.AST]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # bare name: same-module function (prefer non-method defs)
+            cands = self.by_bare_name.get(fn.id, [])
+            return [c for c in cands
+                    if _enclosing_class(self.mod, c) is None]
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("self", "cls"):
+            cls = _enclosing_class(self.mod, caller)
+            if cls is None and isinstance(caller, ast.Lambda):
+                # lambda in a method body: walk up to the class
+                cls = _enclosing_class(self.mod, self.mod.parent(caller) or caller)
+            if cls is not None:
+                hit = self.by_class_method.get((cls.name, fn.attr))
+                return [hit] if hit is not None else []
+        return []
+
+    def loop_context(self) -> Dict[ast.AST, str]:
+        """{function node: why it runs on the loop}."""
+        ctx: Dict[ast.AST, str] = {}
+        pending: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                pending.append((node, f"async def {node.name}"))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if name not in _LOOP_CB_METHODS:
+                    continue
+                args = node.args[1:] if name == "call_later" else node.args
+                for a in args:
+                    if isinstance(a, ast.Lambda):
+                        pending.append((a, f"callback via {name}"))
+                    elif isinstance(a, (ast.Name, ast.Attribute)):
+                        ch = _chain(a)
+                        if not ch:
+                            continue
+                        targets = []
+                        if isinstance(a, ast.Name):
+                            targets = self.by_bare_name.get(ch[-1], [])
+                        elif len(ch) == 2 and ch[0] in ("self", "cls"):
+                            # exactly self.<method> — deeper chains
+                            # (self.loop.stop) are foreign objects
+                            cls = _enclosing_class(self.mod, node)
+                            cur = self.mod.parent(node)
+                            while cls is None and cur is not None:
+                                if isinstance(cur, ast.ClassDef):
+                                    cls = cur
+                                cur = self.mod.parent(cur)
+                            if cls is not None:
+                                hit = self.by_class_method.get(
+                                    (cls.name, ch[-1]))
+                                targets = [hit] if hit else []
+                        for t in targets:
+                            pending.append((t, f"callback via {name}"))
+        while pending:
+            node, why = pending.pop()
+            if node in ctx:
+                continue
+            ctx[node] = why
+            # follow direct sync calls: they execute inline on the loop
+            for sub in _walk_skip_nested(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                for target in self._resolve_call(node, sub):
+                    if isinstance(target, ast.AsyncFunctionDef):
+                        continue  # a coroutine call is awaited, not run
+                    if target not in ctx:
+                        pending.append(
+                            (target,
+                             f"called from loop context "
+                             f"({ctx[node].split(' (')[0]})"))
+        return ctx
+
+
+# --------------------------------------------------------------------------
+# Rule framework
+# --------------------------------------------------------------------------
+
+class Rule:
+    id = "RT000"
+    summary = ""
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        return []
+
+    def project_check(self, modules: List[ModuleInfo]) -> List[Finding]:
+        return []
+
+
+class RT001BlockingOnLoop(Rule):
+    """Blocking calls reachable from ``async def`` bodies or loop callbacks.
+
+    The runtime guard at ``EventLoopThread.run`` (refuse on-loop calls —
+    the PR-1 GC-deadlock fix) made static: ``io.run(...)``, ``time.sleep``,
+    ``run_coroutine_threadsafe(...).result()``, blocking socket ops and
+    thread joins must never execute on the io loop, where they freeze every
+    connection in the process.
+    """
+
+    id = "RT001"
+    summary = "blocking call on the event loop"
+
+    _SOCK_METHODS = {"recv", "recvfrom", "recv_into", "accept", "connect",
+                     "sendall"}
+
+    def _blocking_reason(self, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            recv = _chain(fn.value)
+            if fn.attr == "sleep" and recv == ["time"]:
+                return "time.sleep() blocks the loop (use asyncio.sleep)"
+            if fn.attr == "run":
+                if recv == ["asyncio"]:
+                    return ("asyncio.run() inside a running loop context "
+                            "(await the coroutine instead)")
+                if _is_io_thread_recv(recv):
+                    return (f"{'.'.join(recv)}.run() blocks on the io loop "
+                            f"from the io loop (await / spawn instead)")
+                if recv and recv[-1] == "subprocess":
+                    return "subprocess.run() blocks the loop"
+            if fn.attr == "result" and "threadsafe" in _chain_text(fn.value):
+                return ("run_coroutine_threadsafe(...).result() deadlocks "
+                        "when the target loop is this one")
+            if fn.attr in self._SOCK_METHODS and any(
+                    "sock" in part for part in recv):
+                return (f"blocking socket op .{fn.attr}() on the loop "
+                        f"(use loop transports / run_in_executor)")
+            if fn.attr == "join" and any(
+                    "thread" in part or part == "_thread" for part in recv):
+                return "Thread.join() on the loop can deadlock shutdown"
+        elif isinstance(fn, ast.Name):
+            if fn.id == "sleep":
+                # only when imported from time (module-level alias scan is
+                # overkill; `from time import sleep` is not repo idiom)
+                return None
+        return None
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        graph = _ModuleGraph(mod)
+        for func, why in graph.loop_context().items():
+            for node in _walk_skip_nested(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = self._blocking_reason(node)
+                if reason is None or node.lineno in seen:
+                    continue
+                seen.add(node.lineno)
+                out.append(mod.finding(
+                    self.id, node, f"{reason} [{why}]"))
+        return out
+
+
+class RT002LockAcrossAwait(Rule):
+    """A ``threading`` lock held across ``await``.
+
+    ``with self._lock:`` around an ``await`` keeps an OS lock held while
+    the coroutine is suspended — any non-loop thread then blocking on that
+    lock stalls, and if the loop needs that thread to progress (worker run
+    slots, GC), the process deadlocks. Use an ``asyncio.Lock`` (async
+    with) or restructure so the lock is released before awaiting.
+    """
+
+    id = "RT002"
+    summary = "threading lock held across await"
+
+    _LOCKY = re.compile(r"(lock|mutex|cond|_cv)$|^(lock|cond)")
+
+    def _is_locky(self, expr: ast.AST) -> bool:
+        ch = _chain(expr)
+        return bool(ch) and bool(self._LOCKY.search(ch[-1].lower()))
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_skip_nested(func):
+                if not isinstance(node, ast.With):
+                    continue
+                locky = [i.context_expr for i in node.items
+                         if self._is_locky(i.context_expr)]
+                if not locky:
+                    continue
+                for sub in node.body:
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, (ast.Await, ast.AsyncFor,
+                                              ast.AsyncWith)):
+                            out.append(mod.finding(
+                                self.id, node,
+                                f"threading lock "
+                                f"{_chain_text(locky[0])!r} held across "
+                                f"await at line {inner.lineno}",
+                            ))
+                            break
+                    else:
+                        continue
+                    break
+        return out
+
+
+class RT003BareEnsureFuture(Rule):
+    """``ensure_future``/``create_task`` with no strong reference.
+
+    The event loop holds tasks weakly: a task whose only reference is the
+    ``ensure_future`` return value you dropped can be garbage-collected
+    mid-flight, silently hanging whatever awaited its side effects (the
+    PR-6 lease-prefetch bug class). Keep a strong ref until done
+    (``Connection._spawn`` / ``EventLoopThread._hold_task`` /
+    ``self._bg`` are the repo patterns).
+    """
+
+    id = "RT003"
+    summary = "bare ensure_future/create_task (GC-able task)"
+
+    def _is_task_factory(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in ("ensure_future", "create_task"):
+                recv = _chain(fn.value)
+                return recv == ["asyncio"] or any(
+                    "loop" in p for p in recv)
+        elif isinstance(fn, ast.Name):
+            return fn.id in ("ensure_future", "create_task")
+        return False
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not self._is_task_factory(node):
+                continue
+            parent = mod.parent(node)
+            if isinstance(parent, ast.Expr):
+                out.append(mod.finding(
+                    self.id, node,
+                    "task reference discarded: the loop holds tasks "
+                    "weakly, so this can be GC'd mid-flight — keep a "
+                    "strong ref until done",
+                ))
+            elif isinstance(parent, ast.Lambda) and parent.body is node:
+                out.append(mod.finding(
+                    self.id, node,
+                    "task created inside a lambda callback with no ref "
+                    "holder — GC-able mid-flight once the callback "
+                    "returns; route through a held-task helper",
+                ))
+        return out
+
+
+class RT004DelReachesRuntime(Rule):
+    """``__del__`` reaching into the rpc/backend planes.
+
+    GC runs ``__del__`` on whatever thread dropped the last reference —
+    including the io-loop thread. A destructor that blocks on the loop
+    (PR-1: ``ActorHandle.__del__`` → ``kill_actor``) freezes the process;
+    one that tears down shared runtime state can deadlock shutdown.
+    Destructors may only flip flags and schedule fire-and-forget work.
+    """
+
+    id = "RT004"
+    summary = "__del__ reaches into the rpc/backend planes"
+
+    _DENY_ATTRS = {"kill", "kill_actor", "free_actor", "teardown",
+                   "shutdown", "disconnect"}
+    _DENY_RECV_HINTS = ("gcs", "conn", "rpc", "raylet")
+
+    def _danger(self, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            recv = _chain(fn.value)
+            if fn.attr == "run" and _is_io_thread_recv(recv):
+                return f"blocking {'.'.join(recv)}.run() in __del__"
+            if fn.attr in self._DENY_ATTRS:
+                return f".{fn.attr}() dispatches runtime teardown"
+            if fn.attr in ("call", "call_batched", "notify") and any(
+                    h in p for p in recv for h in self._DENY_RECV_HINTS):
+                return f"rpc {'.'.join(recv)}.{fn.attr}() from __del__"
+            if fn.attr == "sleep" and recv == ["time"]:
+                return "time.sleep() in __del__"
+            if fn.attr == "result":
+                return f"blocking .result() on {_chain_text(fn.value)}"
+        elif isinstance(fn, ast.Name) and fn.id in self._DENY_ATTRS | {
+                "_gcs_call"}:
+            return f"{fn.id}() dispatches runtime teardown"
+        return None
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, ast.FunctionDef) or func.name != "__del__":
+                continue
+            for node in _walk_skip_nested(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                danger = self._danger(node)
+                if danger:
+                    out.append(mod.finding(
+                        self.id, node,
+                        f"{danger} — GC can run this on the io-loop "
+                        f"thread; flip a flag / schedule fire-and-forget "
+                        f"instead",
+                    ))
+        return out
+
+
+class RT005ChaosPointDrift(Rule):
+    """Chaos-point drift: every ``chaos.fire("x.y")`` literal must be in
+    ``ray_tpu.testing.chaos.REGISTERED_POINTS``, every registered point
+    must have a live fire site, and each point's ``builders`` list must
+    match the ``ChaosPlan`` builder methods that actually reference it.
+    The README fault-tolerance table is generated from the same registry
+    (:mod:`ray_tpu.analysis.docs`), so docs cannot drift either.
+    """
+
+    id = "RT005"
+    summary = "chaos injection point not in the registry"
+
+    @staticmethod
+    def _registry() -> Dict[str, dict]:
+        from ray_tpu.testing.chaos import REGISTERED_POINTS
+
+        return REGISTERED_POINTS
+
+    @staticmethod
+    def _fire_calls(mod: ModuleInfo) -> Iterator[ast.Call]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "fire":
+                recv = _chain(node.func.value)
+                if recv and "chaos" in recv[-1]:
+                    yield node
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        if "analysis/" in mod.relpath or mod.relpath.startswith("tests/"):
+            return []
+        out: List[Finding] = []
+        points = self._registry()
+        for call in self._fire_calls(mod):
+            lit = _str_arg(call)
+            if lit is None:
+                out.append(mod.finding(
+                    self.id, call,
+                    "chaos point name must be a string literal (the "
+                    "registry and this rule key on it)",
+                ))
+            elif lit not in points:
+                out.append(mod.finding(
+                    self.id, call,
+                    f"chaos point {lit!r} is not in "
+                    f"chaos.REGISTERED_POINTS — register it (and its "
+                    f"builders) or fix the name",
+                ))
+        # builder methods inside chaos.py itself
+        if mod.relpath.endswith("testing/chaos.py"):
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr == "_rule" \
+                        and _chain(node.func.value) == ["self"]:
+                    lit = _str_arg(node)
+                    if lit is not None and lit not in points:
+                        out.append(mod.finding(
+                            self.id, node,
+                            f"ChaosPlan builder targets unregistered "
+                            f"point {lit!r}",
+                        ))
+        return out
+
+    def project_check(self, modules: List[ModuleInfo]) -> List[Finding]:
+        points = self._registry()
+        chaos_mod = next(
+            (m for m in modules if m.relpath.endswith("testing/chaos.py")),
+            None)
+        if chaos_mod is None:
+            return []  # partial lint (single file): skip project drift
+        fired: Set[str] = set()
+        for mod in modules:
+            if "analysis/" in mod.relpath:
+                continue
+            for call in self._fire_calls(mod):
+                lit = _str_arg(call)
+                if lit is not None:
+                    fired.add(lit)
+        # builder -> point from the ChaosPlan class body
+        builder_points: Dict[str, str] = {}
+        for node in ast.walk(chaos_mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "ChaosPlan":
+                for meth in node.body:
+                    if not isinstance(meth, ast.FunctionDef) \
+                            or meth.name.startswith("_"):
+                        continue
+                    for sub in ast.walk(meth):
+                        if isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Attribute) \
+                                and sub.func.attr == "_rule":
+                            lit = _str_arg(sub)
+                            if lit is not None:
+                                builder_points[meth.name] = lit
+        out: List[Finding] = []
+        for point, info in points.items():
+            if point not in fired:
+                out.append(chaos_mod.finding(
+                    self.id, 1,
+                    f"registered chaos point {point!r} has no live "
+                    f"chaos.fire() site — remove it or wire it in",
+                ))
+            declared = sorted(info.get("builders", ()))
+            actual = sorted(b for b, p in builder_points.items()
+                            if p == point)
+            if declared != actual:
+                out.append(chaos_mod.finding(
+                    self.id, 1,
+                    f"point {point!r} declares builders {declared} but "
+                    f"ChaosPlan defines {actual} for it",
+                ))
+        return out
+
+
+class RT006NameDrift(Rule):
+    """Config-knob / metric-name / env-var drift.
+
+    Knob reads (``_config.x``) must name a ``Config`` field; metric names
+    constructed or read anywhere must exist in
+    ``ray_tpu.util.metrics.KNOWN_METRICS``; ``RAY_TPU_*`` env literals
+    must map to a config field or ``config.KNOWN_ENV_VARS``. A typo'd
+    knob silently reads a default and a typo'd metric silently graphs
+    nothing — both are invisible at runtime, so the gate is static.
+    """
+
+    id = "RT006"
+    summary = "config/metric/env name drift"
+
+    _ENV_RE = re.compile(r"^RAY_TPU_[A-Z0-9_]+$")
+    _METRIC_READERS = {"counter_rate", "window_percentile", "metric_rate",
+                       "metric_percentile", "series"}
+
+    @staticmethod
+    def _config_fields() -> Set[str]:
+        import dataclasses
+
+        from ray_tpu.core.config import Config
+
+        return {f.name for f in dataclasses.fields(Config)}
+
+    @staticmethod
+    def _known_env() -> Set[str]:
+        from ray_tpu.core.config import KNOWN_ENV_VARS
+
+        return set(KNOWN_ENV_VARS)
+
+    @staticmethod
+    def _known_metrics() -> Set[str]:
+        from ray_tpu.util.metrics import KNOWN_METRICS
+
+        return set(KNOWN_METRICS)
+
+    @staticmethod
+    def _metric_ctor_names(mod: ModuleInfo) -> Tuple[Set[str], Set[str]]:
+        """(module aliases of ray_tpu.util.metrics, metric class names
+        imported directly from it)."""
+        aliases: Set[str] = set()
+        direct: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.endswith("util.metrics"):
+                for a in node.names:
+                    if a.name in ("Counter", "Gauge", "Histogram"):
+                        direct.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.endswith("ray_tpu.util"):
+                for a in node.names:
+                    if a.name == "metrics":
+                        aliases.add(a.asname or "metrics")
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.endswith("util.metrics"):
+                        aliases.add(a.asname or a.name.split(".")[0])
+        return aliases, direct
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        is_config = mod.relpath.endswith("core/config.py")
+        is_metrics = mod.relpath.endswith("util/metrics.py")
+        fields = self._config_fields()
+        known_env = self._known_env()
+        known_metrics = self._known_metrics()
+        aliases, direct = self._metric_ctor_names(mod)
+        for node in ast.walk(mod.tree):
+            # ---- _config.<knob> ----
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name) and node.value.id == "_config" \
+                    and not is_config:
+                if node.attr not in fields and not node.attr.startswith("__"):
+                    out.append(mod.finding(
+                        self.id, node,
+                        f"unknown config knob _config.{node.attr!r} — "
+                        f"not a field of core/config.py Config",
+                    ))
+            # ---- RAY_TPU_* env literals ----
+            elif isinstance(node, ast.Constant) and isinstance(
+                    node.value, str) and self._ENV_RE.match(node.value):
+                knob = node.value[len("RAY_TPU_"):].lower()
+                if knob not in fields and node.value not in known_env:
+                    out.append(mod.finding(
+                        self.id, node,
+                        f"env var {node.value!r} maps to no Config field "
+                        f"and is not in config.KNOWN_ENV_VARS",
+                    ))
+            # ---- metric constructions / readers ----
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                ctor = None
+                if isinstance(fn, ast.Name) and fn.id in direct:
+                    ctor = fn.id
+                elif isinstance(fn, ast.Attribute) and fn.attr in (
+                        "Counter", "Gauge", "Histogram"):
+                    recv = _chain(fn.value)
+                    if recv and recv[-1] in (aliases | {"m", "metrics",
+                                                        "metrics_api"}):
+                        ctor = fn.attr
+                if ctor is not None and not is_metrics:
+                    lit = _str_arg(node)
+                    if lit is not None and lit not in known_metrics:
+                        out.append(mod.finding(
+                            self.id, node,
+                            f"metric {lit!r} is not declared in "
+                            f"util.metrics.KNOWN_METRICS — add it there "
+                            f"so readers/dashboards can't drift",
+                        ))
+                else:
+                    name = fn.attr if isinstance(fn, ast.Attribute) else (
+                        fn.id if isinstance(fn, ast.Name) else "")
+                    if name in self._METRIC_READERS and not is_metrics:
+                        for a in list(node.args) + [k.value for k in
+                                                    node.keywords]:
+                            if isinstance(a, ast.Constant) and isinstance(
+                                    a.value, str) and "_" in a.value \
+                                    and a.value not in known_metrics:
+                                out.append(mod.finding(
+                                    self.id, a,
+                                    f"reads metric {a.value!r} that no "
+                                    f"KNOWN_METRICS entry declares — the "
+                                    f"chart would silently show nothing",
+                                ))
+        return out
+
+
+class RT007ClockMisuse(Rule):
+    """Mixed clock domains in deadline/timeout arithmetic.
+
+    Per the PR-10 design, request deadlines (``TaskSpec.deadline``,
+    ``tracing.current_deadline()``) are wall-clock epoch seconds —
+    comparing them against ``time.monotonic()``/``perf_counter()`` (or
+    mixing both clocks in one expression) yields timeouts that are off by
+    the boot-time epoch and never fire (or always fire).
+    """
+
+    id = "RT007"
+    summary = "wall-clock/monotonic clock mixing"
+
+    @staticmethod
+    def _clock_kinds(root: ast.AST) -> Tuple[bool, bool, bool]:
+        """(has time.time(), has monotonic/perf_counter(), references a
+        wall-clock deadline attribute or current_deadline())."""
+        wall = mono = deadline = False
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                ch = _chain(node.func)
+                if ch == ["time", "time"]:
+                    wall = True
+                elif ch[-1:] in (["monotonic"], ["perf_counter"]) and \
+                        ch[:1] == ["time"]:
+                    mono = True
+                elif ch[-1:] == ["current_deadline"]:
+                    deadline = True
+            elif isinstance(node, ast.Attribute) and node.attr == "deadline":
+                recv = _chain(node.value)
+                # spec.deadline / task_spec.deadline / self.spec.deadline:
+                # the cross-process wall-clock one. Bare local `deadline`
+                # names stay unflagged — monotonic local deadlines are fine.
+                if recv and ("spec" in recv[-1] or recv[-1] == "request"):
+                    deadline = True
+        return wall, mono, deadline
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.BinOp, ast.Compare)):
+                continue
+            parent = mod.parent(node)
+            if isinstance(parent, (ast.BinOp, ast.Compare)):
+                continue  # only the top of each arithmetic tree
+            wall, mono, deadline = self._clock_kinds(node)
+            if node.lineno in seen:
+                continue
+            if wall and mono:
+                seen.add(node.lineno)
+                out.append(mod.finding(
+                    self.id, node,
+                    "time.time() and time.monotonic()/perf_counter() "
+                    "mixed in one expression — pick one clock domain",
+                ))
+            elif mono and deadline:
+                seen.add(node.lineno)
+                out.append(mod.finding(
+                    self.id, node,
+                    "monotonic clock compared against a wall-clock "
+                    "request deadline (TaskSpec deadlines are epoch "
+                    "seconds; use time.time())",
+                ))
+        return out
+
+
+_ALL = [RT001BlockingOnLoop(), RT002LockAcrossAwait(), RT003BareEnsureFuture(),
+        RT004DelReachesRuntime(), RT005ChaosPointDrift(), RT006NameDrift(),
+        RT007ClockMisuse()]
+
+
+def all_rules() -> List[Rule]:
+    return list(_ALL)
+
+
+def rule_ids() -> List[str]:
+    return [r.id for r in _ALL]

@@ -13,6 +13,7 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.core.backend import Backend
 from ray_tpu.core.options import RemoteOptions, options_from_kwargs
@@ -34,7 +35,7 @@ class Worker:
 
 
 _worker = Worker()
-_init_lock = threading.Lock()
+_init_lock = _san.make_lock("api.init")
 
 
 def _global_worker() -> Worker:

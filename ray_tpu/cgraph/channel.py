@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu import exceptions as exc
 
 # header layout (one 64-byte block at the file start)
@@ -297,7 +298,7 @@ class IntraProcessChannel:
         self.zero_copy_reads = False  # parity attr: in-process messages
         # already pass by reference, there is nothing to copy out
         self._q: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = _san.make_condition("cgraph.channel")
         self._closed = False
 
     def __reduce__(self):

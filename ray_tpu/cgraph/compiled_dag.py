@@ -46,6 +46,7 @@ import uuid
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu import exceptions as exc_mod
 from ray_tpu.cgraph import executor as ex
 from ray_tpu.cgraph.channel import (
@@ -89,7 +90,7 @@ def teardown_all(timeout: float = 5.0) -> None:
 # over the same actor would queue behind the first forever — fail fast with
 # a clear error instead (same restriction as Ray's compiled graphs).
 _actors_in_use: Dict[bytes, str] = {}
-_actors_in_use_lock = threading.Lock()
+_actors_in_use_lock = _san.make_lock("cgraph.actors_in_use")
 
 
 def actor_in_compiled_graph(actor_handle) -> bool:
@@ -242,9 +243,9 @@ class CompiledDAG:
         # separate locks so teardown() (which only flips the flag before
         # closing channels) can never deadlock behind an execute()/get()
         # blocked inside a channel operation
-        self._exec_lock = threading.Lock()
-        self._read_lock = threading.Lock()
-        self._flag_lock = threading.Lock()
+        self._exec_lock = _san.make_lock("cgraph.exec")
+        self._read_lock = _san.make_lock("cgraph.read")
+        self._flag_lock = _san.make_lock("cgraph.flag")
         self._torn_down = False
         self._broken: Optional[str] = None
         self._submitted = 0
@@ -256,7 +257,7 @@ class CompiledDAG:
         self._partial_entry: List[Tuple[str, Any]] = []
         # GC'd-without-get() seqs whose buffered results should be evicted
         self._abandoned: set = set()
-        self._abandoned_lock = threading.Lock()
+        self._abandoned_lock = _san.make_lock("cgraph.abandoned")
         # seq -> weakref to its CompiledDAGRef: the cache backstop only
         # evicts seqs whose ref is provably gone (a live ref's result is
         # never dropped out from under the caller)
@@ -1105,7 +1106,22 @@ class CompiledDAG:
                     del _actors_in_use[aid]
 
     def __del__(self):
+        # teardown blocks (channel closes, actor kills, backend calls) and
+        # GC can run __del__ on the io-loop thread — hand the work to a
+        # short-lived daemon thread instead of dispatching it here
+        # (raylint RT004; the PR-1 ActorHandle.__del__ deadlock class).
+        # Tradeoff: GC-triggered teardown is now ASYNCHRONOUS — dropping
+        # the last ref and immediately re-compiling over the same actors
+        # can race the _actors_in_use release. Call teardown() explicitly
+        # (as serve's recompile path does) when you need determinism;
+        # ray_tpu.shutdown() still tears down every live graph at exit.
         try:
-            self.teardown(timeout=1.0)
+            with self._flag_lock:
+                if self._torn_down:
+                    return
+            threading.Thread(
+                target=self.teardown, kwargs={"timeout": 1.0},
+                name="cgraph-gc-teardown", daemon=True,
+            ).start()
         except Exception:  # noqa: BLE001 - interpreter shutdown
             pass

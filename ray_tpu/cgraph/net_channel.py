@@ -329,7 +329,8 @@ class NetChannel:
                 core._gcs_call_retrying(
                     "get_channel_endpoint", channel_id=self.channel_id,
                     wait_timeout=0.0, attempts=1,
-                )
+                ),
+                timeout=10,  # close path: bounded like _tombstone below
             )
             if entry and not entry.get("closed") and "dropped" not in entry:
                 ep = entry["endpoint"]
@@ -366,7 +367,8 @@ class NetChannel:
             core.io.run(
                 core._gcs_call_retrying(
                     "close_channel", channel_id=self.channel_id, attempts=1,
-                )
+                ),
+                timeout=10,  # teardown: never hang exit on a dead io loop
             )
         except Exception:  # noqa: BLE001 - shutdown path
             pass

@@ -158,7 +158,8 @@ class ClientBackend(Backend):
 
         refs_mod.set_on_zero_callback(None)
         try:
-            self.io.run(self._conn.close())
+            # bounded: a dead io loop must not hang client shutdown
+            self.io.run(self._conn.close(), timeout=5)
         except Exception:  # noqa: BLE001
             pass
         self.io.stop()

@@ -71,7 +71,11 @@ class ClientServer:
 
     def stop(self):
         if self._loop_thread:
-            self._loop_thread.run(self.server.close())
+            try:
+                # bounded: a wedged connection close must not hang exit
+                self._loop_thread.run(self.server.close(), timeout=5)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
             self._loop_thread.stop()
 
     # -------------------------------------------------------------- helpers

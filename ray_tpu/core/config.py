@@ -14,6 +14,27 @@ from dataclasses import dataclass, field, fields
 from typing import Any, Dict
 
 
+# RAY_TPU_* environment variables that are NOT config-knob overrides
+# (addresses, tokens, chaos-plan propagation, sanitizer master switch).
+# raylint RT006 checks every RAY_TPU_* literal in the tree against the
+# Config fields plus this set, so a typo'd knob name can't silently read
+# its default forever.
+KNOWN_ENV_VARS = frozenset({
+    "RAY_TPU_ADDRESS",
+    "RAY_TPU_TOKEN",
+    "RAY_TPU_GCS_ADDRESS",
+    "RAY_TPU_RAYLET_ADDRESS",
+    "RAY_TPU_SESSION",
+    "RAY_TPU_NODE_ID",
+    "RAY_TPU_STARTUP_TOKEN",
+    "RAY_TPU_PRESERVED_TPU_ENV",
+    "RAY_TPU_LOCAL_MODE",
+    "RAY_TPU_CHAOS_PLAN",
+    "RAY_TPU_CHAOS_LOG",
+    "RAY_TPU_SANITIZE",
+})
+
+
 def _env(name: str, default):
     raw = os.environ.get(f"RAY_TPU_{name.upper()}")
     if raw is None:
@@ -215,6 +236,15 @@ class Config:
     # metrics time series, sampled every metrics_report_interval_ms
     # (240 x 2s = 8 minutes of history by default)
     metrics_timeseries_depth: int = 240
+
+    # --- dev-mode runtime sanitizers (RAY_TPU_SANITIZE=1, analysis/) -------
+    # io-loop watchdog: a loop that fails to run a scheduled heartbeat for
+    # this long is recorded as a stall violation (a blocking call is
+    # squatting the loop). Generous by default: oversubscribed CI boxes
+    # legitimately delay thread scheduling.
+    sanitize_loop_stall_s: float = 5.0
+    # how often the watchdog pings each registered EventLoopThread
+    sanitize_loop_ping_interval_s: float = 1.0
 
     def __post_init__(self):
         for f in fields(self):

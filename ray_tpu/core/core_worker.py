@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu import exceptions as exc
 from ray_tpu import tracing
 from ray_tpu.core import rpc, serialization, task_spec as ts
@@ -247,7 +248,7 @@ class CoreWorker:
         # driver: GCS-assigned job id; workers tag submissions with the
         # EXECUTING task's job instead (tracing.current_job_id())
         self.job_id: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("core.worker")
         # actor lifecycle listeners fed by the GCS "actor" pubsub channel
         # (compiled graphs subscribe their participants here)
         self._actor_listeners: List[Any] = []
@@ -2152,7 +2153,9 @@ class CoreWorker:
                     if not st.recovering:
                         st.recovering = True
                         st.gate.clear()
-                        asyncio.ensure_future(self._recover_actor_calls(st))
+                        self._hold_bg(
+                            asyncio.ensure_future(
+                                self._recover_actor_calls(st)))
                     continue
                 fut = await conn.call_start_batched(
                     "push_actor_task", spec=spec
@@ -2226,7 +2229,8 @@ class CoreWorker:
         if not st.recovering:
             st.recovering = True
             st.gate.clear()
-            asyncio.ensure_future(self._recover_actor_calls(st))
+            self._hold_bg(
+                asyncio.ensure_future(self._recover_actor_calls(st)))
 
     async def _recover_actor_calls(self, st: "_ActorSubmitState"):
         """Replay failed calls in sequence order after a connection loss.

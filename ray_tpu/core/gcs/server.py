@@ -128,6 +128,10 @@ class GcsServer:
         self._conn_owned_actors: Dict[rpc.Connection, Set[bytes]] = {}
         self._conn_owned_pgs: Dict[rpc.Connection, Set[bytes]] = {}
         self._bg: List[asyncio.Task] = []
+        # strong refs to one-shot retry tasks until done (the loop holds
+        # tasks weakly: a bare ensure_future in a timer callback is
+        # GC-able mid-flight — raylint RT003)
+        self._held_tasks: set = set()
         # observability: bounded per-task event aggregation (GcsTaskManager
         # analog, gcs_task_manager.h:61) + monotonically-counted metrics
         from ray_tpu.tracing import TaskEventAggregator
@@ -290,13 +294,9 @@ class GcsServer:
         )
         # restored actors/PGs reschedule once nodes re-register
         for info in list(self.actors.values()):
-            asyncio.get_event_loop().call_later(
-                1.0, lambda i=info: asyncio.ensure_future(self._retry_schedule(i))
-            )
+            self._call_later_held(1.0, self._retry_schedule, info)
         for pg in list(self.placement_groups.values()):
-            asyncio.get_event_loop().call_later(
-                1.0, lambda p=pg: asyncio.ensure_future(self._retry_place_pg(p))
-            )
+            self._call_later_held(1.0, self._retry_place_pg, pg)
 
     # ------------------------------------------------------------- pubsub
     async def publish(self, channel: str, payload):
@@ -610,9 +610,7 @@ class GcsServer:
                 )
                 return
             if not pg.placement:
-                asyncio.get_running_loop().call_later(
-                    0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
-                )
+                self._call_later_held(0.5, self._retry_schedule, info)
                 return
             if info.bundle_index >= 0:
                 idx = info.bundle_index
@@ -636,10 +634,7 @@ class GcsServer:
                     node_id = hint
                 elif info.sched_attempts < 20:
                     info.sched_attempts += 1
-                    asyncio.get_running_loop().call_later(
-                        0.5,
-                        lambda: asyncio.ensure_future(self._retry_schedule(info)),
-                    )
+                    self._call_later_held(0.5, self._retry_schedule, info)
                     return
                 else:
                     info.restore_node_hint = None
@@ -656,9 +651,7 @@ class GcsServer:
                 )
         if node_id is None or node_id not in self.nodes:
             # queue until resources free up: retry on next resource report
-            asyncio.get_running_loop().call_later(
-                0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
-            )
+            self._call_later_held(0.5, self._retry_schedule, info)
             return
         node = self.nodes[node_id]
         info.node_id = node_id
@@ -681,9 +674,19 @@ class GcsServer:
             if info.pg_id is None:
                 node.available = node.available.add(demand)
             info.node_id = None
-            asyncio.get_running_loop().call_later(
-                0.5, lambda: asyncio.ensure_future(self._retry_schedule(info))
-            )
+            self._call_later_held(0.5, self._retry_schedule, info)
+
+    def _call_later_held(self, delay: float, coro_fn, *args) -> None:
+        """Run ``coro_fn(*args)`` as a task after ``delay``, holding a
+        strong ref until it finishes. The scheduling/retry paths all
+        funnel through here: a dropped retry task means an actor or PG
+        that silently never places."""
+        def _spawn():
+            t = asyncio.ensure_future(coro_fn(*args))
+            self._held_tasks.add(t)
+            t.add_done_callback(self._held_tasks.discard)
+
+        asyncio.get_running_loop().call_later(delay, _spawn)
 
     async def _retry_schedule(self, info: ActorInfo):
         if info.state in (PENDING, RESTARTING):
@@ -965,20 +968,14 @@ class GcsServer:
                     info.state = "CREATED"
                     return
             if attempts < 30:
-                asyncio.get_event_loop().call_later(
-                    1.0, lambda: asyncio.ensure_future(
-                        self._retry_place_pg(info, attempts + 1)
-                    )
-                )
+                self._call_later_held(1.0, self._retry_place_pg, info,
+                                      attempts + 1)
                 return
             info.placement = None  # original nodes gone: place fresh
             info.state = "PENDING"
         if not await self._try_place_pg(info):
-            asyncio.get_event_loop().call_later(
-                1.0, lambda: asyncio.ensure_future(
-                    self._retry_place_pg(info, attempts + 1)
-                )
-            )
+            self._call_later_held(1.0, self._retry_place_pg, info,
+                                  attempts + 1)
 
     async def _try_place_pg(self, info: PlacementGroupInfo) -> bool:
         views = [n.view() for n in self.nodes.values()]

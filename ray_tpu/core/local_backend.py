@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu import exceptions as exc
 from ray_tpu import tracing
 from ray_tpu.core.backend import Backend
@@ -109,7 +110,7 @@ class LocalBackend(Backend):
         self._objects: Dict[ObjectID, concurrent.futures.Future] = {}
         self._actors: Dict[ActorID, _LocalActor] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("core.local_backend")
         self._cancelled: set = set()
         self._actor_listeners: List[Any] = []
         # tracing: local mode has no GCS — the process buffer drains into an

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.ids import ObjectID
 
 _SHM_ROOT = "/dev/shm"
@@ -158,7 +159,7 @@ class ObjectDirectory:
             default_spill_root(client.dir), node_id
         )
         self.spilled: Dict[ObjectID, str] = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("core.shm_store")
 
     def add(self, oid: ObjectID, nbytes: int):
         with self._lock:

@@ -94,6 +94,11 @@ class Raylet:
         self.bundles: Dict[Tuple[bytes, int], ResourceSet] = {}
         self.bundle_free: Dict[Tuple[bytes, int], ResourceSet] = {}
         self._bg: List[asyncio.Task] = []
+        # strong refs to one-shot tasks (dispatch kicks, actor adoption
+        # announcements) until done — the loop holds tasks weakly and a
+        # GC'd dispatch kick leaves granted-but-unsent leases (raylint
+        # RT003)
+        self._held_tasks: set = set()
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._actor_specs: Dict[bytes, bytes] = {}
         self.transfer = None               # native data-plane daemon
@@ -113,6 +118,12 @@ class Raylet:
         # re-acquire must draw from the SAME bundle, not node availability
         self._lease_pg: Dict[str, Tuple[Optional[bytes], int]] = {}
         self._m_lease_grant = None  # queued->granted latency histogram
+
+    def _hold(self, task: "asyncio.Task") -> "asyncio.Task":
+        """Keep a one-shot task alive until done (RT003 pattern)."""
+        self._held_tasks.add(task)
+        task.add_done_callback(self._held_tasks.discard)
+        return task
 
     def _observe_lease_grant(self, lease: LeaseRequest) -> None:
         if not _config.metrics_enabled:
@@ -713,7 +724,7 @@ class Raylet:
         # 50 ms poll tick (that cap showed up as ~80 task/s in the
         # microbenchmark — one dispatch round per tick)
         if self.pending_leases:
-            asyncio.ensure_future(self._dispatch())
+            self._hold(asyncio.ensure_future(self._dispatch()))
         return True
 
     def handle_return_leases(self, conn, lease_ids):
@@ -960,9 +971,9 @@ class Raylet:
             # GCS restarted (fault tolerance) and is rescheduling an actor
             # that never died: adopt the live worker instead of spawning a
             # duplicate (which would also double-book its resources)
-            asyncio.ensure_future(
+            self._hold(asyncio.ensure_future(
                 self._announce_adopted_actor(actor_id, existing.address)
-            )
+            ))
             return True
         demand = ResourceSet(resources)
         token = self._acquire(demand, pg_id, bundle_index)

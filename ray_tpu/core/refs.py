@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.ids import ObjectID, TaskID
 
 # ---------------------------------------------------------------------------
@@ -22,7 +23,7 @@ from ray_tpu.core.ids import ObjectID, TaskID
 # no pending tasks/borrowers remain; borrowers use it to send a release to
 # the owner (core_worker._on_local_refs_zero).
 # ---------------------------------------------------------------------------
-_reg_lock = threading.Lock()
+_reg_lock = _san.make_lock("core.refs")
 _local_counts: Dict[bytes, int] = {}
 _owner_addrs: Dict[bytes, Optional[str]] = {}  # last-seen owner per live oid
 _on_zero: Optional[Callable[[ObjectID, Optional[str], Optional[TaskID]], None]] = None

@@ -43,6 +43,7 @@ import traceback
 from collections import deque
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.config import _config
 from ray_tpu.testing import chaos as _chaos
 
@@ -487,6 +488,10 @@ class Connection:
             self._schedule_flush()
 
     def _append_encoded(self, msg) -> None:
+        # the outbox and its byte counters are loop-only state: appends
+        # interleave only at await points (the flusher's empty-check
+        # depends on it) — a cross-thread append would corrupt framing
+        _san.assert_loop_affinity("rpc.Connection.outbox", self._loop)
         chunks, nbytes, oob = _encode_frame(msg)
         self._outbox.extend(chunks)
         self._outbox_bytes += nbytes
@@ -932,11 +937,14 @@ class EventLoopThread:
         # spawn_batched state: queued (fn, args) pairs + a dirty flag so a
         # burst of cross-thread submissions costs ONE self-pipe wake
         self._calls: list = []
-        self._calls_lock = threading.Lock()
+        self._calls_lock = _san.make_lock("rpc.io_calls")
         self._calls_scheduled = False
         self._held_tasks: set = set()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
+        # dev-mode: the io-loop watchdog records a violation (with the
+        # loop thread's live stack) if this loop stops running callbacks
+        _san.watch_event_loop_thread(self)
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
@@ -985,6 +993,10 @@ class EventLoopThread:
                 fn.close()  # silence "never awaited" at interpreter exit
 
     def _drain_calls(self) -> None:
+        # loop-only: ensure_future below binds tasks to THIS loop; running
+        # it anywhere else would strand them on a foreign loop
+        _san.assert_thread_affinity("rpc.EventLoopThread._drain_calls",
+                                    self._thread.ident)
         with self._calls_lock:
             batch, self._calls = self._calls, []
             self._calls_scheduled = False

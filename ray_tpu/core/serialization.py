@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Tuple
 
 import cloudpickle
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.refs import ObjectRef
 
 
@@ -156,7 +157,7 @@ def _device_get_if_jax(value):
 # cloudpickle.register_pickle_by_value mutates process-global state; concurrent
 # serialize() calls must not unregister a module while another dump is mid-
 # flight (advisor finding r2). Registrations are reference-counted under a lock.
-_BY_VALUE_LOCK = threading.Lock()
+_BY_VALUE_LOCK = _san.make_lock("core.serialization.by_value")
 _BY_VALUE_COUNTS: Dict[str, int] = {}
 
 

@@ -61,6 +61,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu import exceptions as exc
 from ray_tpu.core.config import _config
 
@@ -231,7 +232,7 @@ class ReaderState:
         self.token = token
         self.max_msgs = max(1, int(max_msgs))
         self.spool_dir = spool_dir
-        self._cond = threading.Condition()
+        self._cond = _san.make_condition("transport.reader")
         self._q: deque = deque()
         self._conn: Optional[socket.socket] = None
         self._send_lock = threading.Lock()
@@ -437,7 +438,7 @@ class WriterState:
     def __init__(self, sock: socket.socket, channel_id: str, credits: int):
         self.channel_id = channel_id
         self._sock = sock
-        self._cond = threading.Condition()
+        self._cond = _san.make_condition("transport.writer")
         self._credits = credits
         self._seq = 0
         self._ended: Optional[Tuple[str, str]] = None
@@ -630,7 +631,7 @@ class StreamListener:
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._readers: Dict[str, ReaderState] = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("transport.listener")
         self._closed = False
         self._thread = threading.Thread(
             target=self._accept_loop, name="rt-stream-listener", daemon=True
@@ -722,7 +723,7 @@ class StreamListener:
 
 
 _listener: Optional[StreamListener] = None
-_listener_lock = threading.Lock()
+_listener_lock = _san.make_lock("transport.listener_registry")
 # node-level default advertise host (normally the raylet's host), used when
 # binding all interfaces with no explicit transport_advertise_host
 _default_advertise_host: str = ""

@@ -236,6 +236,18 @@ def render_metrics_snapshot(samples) -> str:
     if overload:
         lines.append("")
         lines.append("overload: " + "  ".join(overload))
+    # dev-mode sanitizer trips anywhere in the cluster (daemon processes
+    # flush the counter to the GCS like any other metric) — a lock-order
+    # cycle or io-loop stall in production is an incident, surface it
+    s = series("sanitizer_violations_total")
+    if s and s["points"]:
+        by_kind = {}
+        for tags_, v in s["points"].items():
+            kind = dict(tags_).get("kind", "?")
+            by_kind[kind] = by_kind.get(kind, 0) + v
+        lines.append("")
+        lines.append("SANITIZER VIOLATIONS: " + "  ".join(
+            f"{k}={v:,.0f}" for k, v in sorted(by_kind.items())))
     gauge_names = (
         "raylet_pending_leases", "raylet_active_leases",
         "object_store_used_bytes", "object_store_num_objects",
@@ -315,6 +327,37 @@ def cmd_metrics(args) -> int:
         if rounds <= 0 or i < rounds:
             _time.sleep(args.interval)
     return 0
+
+
+def cmd_lint(args) -> int:
+    """raylint: the project's concurrency/protocol static-analysis suite
+    (ray_tpu/analysis). Exit 0 = no unsuppressed findings; the same run is
+    asserted clean by tier-1 (tests/test_static_analysis.py)."""
+    from ray_tpu.analysis import lint_package, lint_paths
+
+    if args.update_docs:
+        from ray_tpu.analysis.docs import readme_path, update_readme
+
+        changed = update_readme()
+        print(f"{readme_path()}: "
+              f"{'updated' if changed else 'already in sync'}")
+
+    result = lint_paths(args.paths) if args.paths else lint_package()
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        shown = result.findings if args.all else result.unsuppressed
+        for f in shown:
+            print(f)
+        for e in result.errors:
+            print(f"ERROR: {e}", file=sys.stderr)
+        n = len(result.unsuppressed)
+        sup = sum(1 for f in result.findings if f.suppressed)
+        base = sum(1 for f in result.findings if f.baselined)
+        print(f"raylint: {result.files} files, {n} finding(s) "
+              f"({sup} suppressed, {base} baselined, "
+              f"{len(result.errors)} error(s))")
+    return 0 if result.clean else 1
 
 
 def cmd_timeline(args) -> int:
@@ -402,6 +445,20 @@ def main(argv=None) -> int:
     p.add_argument("--window", type=int, default=30,
                    help="how many ring samples the rates/percentiles span")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "lint", help="run raylint (RT001-RT007 static analysis) over the "
+        "package; exit 0 = clean")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the whole package)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument("--all", action="store_true",
+                   help="also show suppressed/baselined findings")
+    p.add_argument("--update-docs", action="store_true",
+                   help="regenerate the README chaos-point table from "
+                        "chaos.REGISTERED_POINTS before linting")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("timeline", help="export Chrome-trace task timeline")
     p.add_argument("--address")

@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.analysis import sanitizers as _san
+
 
 class _BatchQueue:
     """Per-(instance, method) batching state + flusher thread."""
@@ -38,7 +40,7 @@ class _BatchQueue:
         self.fn = fn
         self.max = max_batch_size
         self.timeout = batch_wait_timeout_s
-        self.cond = threading.Condition()
+        self.cond = _san.make_condition("serve.batch")
         self.items: List[tuple] = []          # (arg, Future)
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True, name="serve-batcher"
@@ -95,7 +97,7 @@ class _BatchedCallable:
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
         self._free_queue: Optional[_BatchQueue] = None  # plain-function case
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.batch.state")
         functools.update_wrapper(self, fn)
 
     def __reduce__(self):

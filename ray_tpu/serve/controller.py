@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.analysis import sanitizers as _san
+
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "__serve_controller"
@@ -56,13 +58,13 @@ class ServeController:
         # ("open"/"half_open"; closed entries are removed)
         self._circuit_states: Dict[str, Dict[str, str]] = {}
         self._version = 0
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.controller.state")
         # serializes whole reconcile passes: deploy() calls _reconcile from
         # handler threads while the ticker thread runs it too — without
         # mutual exclusion both see len(actors) < target during the (slow,
         # blocking) health probes and double-create replicas, leaking CPU
         # until fresh replicas sit PENDING forever
-        self._reconcile_mutex = threading.Lock()
+        self._reconcile_mutex = _san.make_lock("serve.controller.reconcile")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"

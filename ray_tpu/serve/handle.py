@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu import exceptions as exc
 from ray_tpu import tracing
 from ray_tpu.core.config import _config
@@ -146,7 +147,7 @@ class Router:
         # dep → replica-id bytes → in-flight count (keyed by stable
         # replica identity, NOT list position: eviction reshuffles indices)
         self._inflight: Dict[str, Dict[bytes, int]] = {}
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.router")
         # capacity plane: requests beyond replicas x max_ongoing wait HERE
         # (router-side queue, the reference's pending_requests), woken by
         # completions; the queue depth is bounded by max_queued_requests
@@ -1103,7 +1104,7 @@ class CompiledDeploymentHandle:
         self.deployment_name = deployment_name
         self._router = router
         self._max_in_flight = max_in_flight
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("serve.compiled_handle")
         self._compiled = None
         self._replica = None
         self._closed = False

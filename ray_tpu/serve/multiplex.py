@@ -29,6 +29,8 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from ray_tpu.analysis import sanitizers as _san
+
 _ctx = threading.local()
 
 
@@ -58,7 +60,7 @@ class _MultiplexWrapper:
         entry = state.get(id(self))
         if entry is None:
             entry = state[id(self)] = {
-                "lru": OrderedDict(), "lock": threading.Lock(),
+                "lru": OrderedDict(), "lock": _san.make_lock("serve.multiplex"),
             }
 
         def bound(model_id: str):

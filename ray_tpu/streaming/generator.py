@@ -20,13 +20,14 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.ids import ObjectID, TaskID
 from ray_tpu.core.refs import ObjectRef
 
 # owner-buffer guard plane (shared by all streams in this process): live
 # over-cap unwindowed streams by identity → buffered count; the gauge
 # exports the MAX and drops to 0 once every backlog drains or closes
-_backlog_lock = threading.Lock()
+_backlog_lock = _san.make_lock("streaming.backlog")
 _backlogged: dict = {}
 _backlog_gauge = None
 _items_counter = None
@@ -98,7 +99,7 @@ class StreamState:
         self.explicit_window = explicit_window
         self._buffer_warned = False
         self._was_backlogged = False
-        self._cond = threading.Condition()
+        self._cond = _san.make_condition("streaming.state")
         self.count = 0            # items reported ready (max index + 1)
         self.consumed = 0         # items handed to the consumer
         self.total: Optional[int] = None   # set once the producer finished

@@ -69,10 +69,76 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu.analysis import sanitizers as _san
+
 logger = logging.getLogger(__name__)
 
 ENV_PLAN = "RAY_TPU_CHAOS_PLAN"
 ENV_LOG = "RAY_TPU_CHAOS_LOG"
+
+# --------------------------------------------------------------------------
+# Registered injection points: the single source of truth the rest of the
+# tree is checked against. raylint RT005 statically verifies that every
+# ``chaos.fire("<point>")`` literal in production code names an entry here,
+# that every entry has at least one live fire site, and that each entry's
+# ``builders`` list matches the ChaosPlan builder methods that reference
+# it; ``ChaosPlan._rule`` enforces membership at runtime; the README
+# fault-tolerance point table is GENERATED from this dict
+# (ray_tpu/analysis/docs.py), so prose can't drift either.
+# --------------------------------------------------------------------------
+REGISTERED_POINTS: Dict[str, Dict[str, Any]] = {
+    "rpc.send": {
+        "module": "ray_tpu/core/rpc.py",
+        "builders": ["drop_rpc", "delay_rpc", "sever_rpc"],
+        "where": "Connection request-frame send: the Nth matching request "
+                 "frame is dropped / delayed / the connection severed",
+    },
+    "rpc.handle": {
+        "module": "ray_tpu/core/rpc.py",
+        "builders": ["restart_gcs"],
+        "where": "Connection dispatch, after the handler ran and before "
+                 "the response frame: the serving process can exit "
+                 "mid-call (GCS restart injection) or swallow/delay the "
+                 "reply",
+    },
+    "worker.lease": {
+        "module": "ray_tpu/core/raylet/worker_pool.py",
+        "builders": ["kill_worker"],
+        "where": "the worker granted the Nth task lease is SIGKILLed",
+    },
+    "actor.call": {
+        "module": "ray_tpu/core/worker_main.py + core/local_backend.py",
+        "builders": ["kill_actor"],
+        "where": "actor-task execution: the actor's process dies at the "
+                 "Nth matching Class.method call",
+    },
+    "cgraph.iter": {
+        "module": "ray_tpu/cgraph/executor.py",
+        "builders": ["kill_cgraph_actor"],
+        "where": "compiled-graph execution loop: a participant dies at "
+                 "the Nth loop iteration",
+    },
+    "stream.yield": {
+        "module": "ray_tpu/core/worker_main.py + core/local_backend.py",
+        "builders": ["kill_stream_producer"],
+        "where": "streaming-generator producers: the producer dies right "
+                 "before yielding the Nth item, so consumers must see a "
+                 "typed error on the next item",
+    },
+    "channel.send": {
+        "module": "ray_tpu/cgraph/net_channel.py",
+        "builders": ["sever_channel"],
+        "where": "the Nth write on a cross-node compiled-graph channel "
+                 "severs its stream connection (or is delayed)",
+    },
+    "replica.handle": {
+        "module": "ray_tpu/serve/replica.py",
+        "builders": ["slow_replica"],
+        "where": "serve-replica request entry (unary + streaming): "
+                 "matching calls are delayed — deterministic slow-replica "
+                 "injection driving the circuit breaker",
+    },
+}
 
 
 class ChaosKilled(BaseException):
@@ -95,6 +161,12 @@ class ChaosPlan:
     # ------------------------------------------------------------- builders
     def _rule(self, point: str, action: str, *, match: str = "", nth: int = 1,
               repeat: bool = False, **extra) -> "ChaosPlan":
+        if point not in REGISTERED_POINTS:
+            raise ValueError(
+                f"unknown chaos point {point!r}: every injection point "
+                f"must be declared in chaos.REGISTERED_POINTS "
+                f"(known: {sorted(REGISTERED_POINTS)})"
+            )
         r = {"point": point, "action": action, "match": match,
              "nth": max(1, int(nth)), "repeat": bool(repeat)}
         r.update(extra)
@@ -238,7 +310,7 @@ class _Runtime:
         self.counters = [0] * len(cplan.rules)
         self.fired = [0] * len(cplan.rules)
         self.rng = random.Random(cplan.seed)
-        self.lock = threading.Lock()
+        self.lock = _san.make_lock("chaos.runtime")
         self.log_path = os.environ.get(ENV_LOG)
         self.events: List[Dict[str, Any]] = []  # this process's firings
 

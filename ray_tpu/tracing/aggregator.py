@@ -12,6 +12,7 @@ import threading
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.config import _config
 from ray_tpu.tracing import events as ev
 
@@ -83,7 +84,7 @@ class TaskEventAggregator:
                  max_events_per_task: int = 256,
                  max_profile_events: int = 20_000,
                  max_tasks_per_job: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("tracing.aggregator")
         self._max_tasks = max_tasks or max(100, _config.task_events_max_tasks)
         self._max_tasks_per_job = max_tasks_per_job or max(
             10, _config.task_events_max_tasks_per_job

@@ -17,6 +17,7 @@ import zlib
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.analysis import sanitizers as _san
 from ray_tpu.core.config import _config
 
 # compact WAL line encoder: separators + no circular check shave ~40% off
@@ -179,7 +180,7 @@ class TaskEventBuffer:
     """
 
     def __init__(self, capacity: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("tracing.buffer")
         self._capacity = capacity or max(100, _config.task_events_buffer_size)
         self._events: deque = deque()
         self._dropped = 0          # cumulative, this process
@@ -380,7 +381,7 @@ class TaskEventBuffer:
 
 
 _buffer: Optional[TaskEventBuffer] = None
-_buffer_lock = threading.Lock()
+_buffer_lock = _san.make_lock("tracing.buffers_global")
 
 
 def get_buffer() -> TaskEventBuffer:

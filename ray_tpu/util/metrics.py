@@ -39,6 +39,60 @@ LATENCY_MS_BOUNDS = [1, 2, 5, 10, 25, 50, 100, 250, 500,
                      1000, 2500, 5000, 10000, 30000]
 
 
+# Registry of every built-in metric name the runtime emits. raylint RT006
+# checks both sides against it: a Counter/Gauge/Histogram constructed with
+# a literal name not listed here is a finding, and so is a reader
+# (counter_rate / window_percentile / scripts metrics) referencing a name
+# nothing emits — the drift that makes a chart silently flatline.
+# Dynamically-named series (the raylet's f"raylet_dispatch_{decision}"
+# gauges) are out of the static rule's reach and not listed.
+KNOWN_METRICS: Dict[str, str] = {
+    # task plane (derived at the GCS aggregator from lifecycle events)
+    "task_e2e_ms": "task submit -> terminal state",
+    "task_exec_ms": "task RUNNING -> EXECUTED",
+    "task_deadline_expired_total": "tasks shed on an expired deadline",
+    # serve router / replica / proxy
+    "serve_request_latency_ms": "end-to-end latency at the router",
+    "serve_queue_wait_ms": "arrival -> dispatched to a replica",
+    "serve_requests_total": "requests dispatched",
+    "serve_request_errors_total": "requests that errored",
+    "serve_failovers_total": "dead-replica evictions",
+    "serve_replica_inflight": "router-local in-flight requests",
+    "serve_shed_total": "requests shed by admission control",
+    "serve_deadline_expired_total": "serve requests shed on deadline",
+    "serve_retry_budget_exhausted_total": "retries suppressed by the budget",
+    "serve_circuit_open": "replicas ejected by an open breaker",
+    "serve_exec_latency_ms": "user-callable latency at the replica",
+    "serve_replica_ongoing": "requests executing in a replica",
+    "serve_http_requests_total": "HTTP requests by route and code",
+    "serve_http_latency_ms": "HTTP dispatch latency at the proxy",
+    # raylet / object store
+    "raylet_lease_grant_ms": "lease queued -> worker granted",
+    "raylet_pending_leases": "lease requests queued",
+    "raylet_active_leases": "leases holding resources",
+    "raylet_workers": "worker processes by state",
+    "raylet_dispatch_ticks": "poll-loop iterations",
+    "object_store_used_bytes": "bytes sealed in the local shm store",
+    "object_store_num_objects": "objects in the local shm store",
+    "object_store_num_spilled": "objects spilled to disk",
+    # cgraph / transport / streaming
+    "cgraph_execute_ms": "compiled-graph execute -> first get",
+    "channel_bytes_sent": "bytes over cross-node cgraph channels",
+    "channel_credit_stall_ms": "writer time blocked on transport credits",
+    "streaming_items_total": "stream items reported to the owner",
+    "streaming_owner_buffered_items": "unconsumed pushed items buffered",
+    # rpc wire counters (mirrored into the registry by every flush loop)
+    "rpc_frames_sent": "frames written to the wire",
+    "rpc_bytes_sent": "bytes written to the wire",
+    "rpc_frames_coalesced": "frames that shared a gather-write",
+    "rpc_oob_bytes": "bytes sent out-of-band",
+    "rpc_flushes": "outbox gather-writes",
+    "rpc_frames_recv": "frames read from the wire",
+    # dev-mode runtime sanitizers (analysis/sanitizers.py)
+    "sanitizer_violations_total": "sanitizer violations by kind",
+}
+
+
 def _tags_key(tags: Optional[Dict[str, str]]) -> _TagTuple:
     return tuple(sorted((tags or {}).items()))
 
@@ -52,6 +106,8 @@ class _Series:
         self.kind = kind  # counter | gauge | histogram
         self.description = description
         self.boundaries = list(boundaries or [])
+        # hot leaf lock (every inc/observe), never nested inside another
+        # named lock — left plain so the sanitizer costs nothing here
         self.lock = threading.Lock()
         # counter/gauge: tags -> float
         # histogram: tags -> [bucket_counts..., +inf_count, sum, count]
@@ -74,7 +130,9 @@ class _Series:
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        from ray_tpu.analysis.sanitizers import make_lock
+
+        self._lock = make_lock("metrics.registry")
         self._series: Dict[str, _Series] = {}
 
     def series(self, name: str, kind: str, description: str,
@@ -295,8 +353,10 @@ class MetricsTimeSeries:
 
         from ray_tpu.core.config import _config
 
+        from ray_tpu.analysis.sanitizers import make_lock
+
         self.depth = max(2, depth or _config.metrics_timeseries_depth)
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.timeseries")
         self._ring: "deque" = deque(maxlen=self.depth)
 
     def sample(self, series_list: List[dict], ts: Optional[float] = None):

@@ -119,11 +119,19 @@ def summarize_metrics() -> Dict[str, Any]:
     flush loop, so the cluster-wide sums live in `/metrics` and
     `get_metrics_timeseries`; this merge keeps the calling driver's own
     totals visible even before its first flush."""
+    from ray_tpu.analysis import sanitizers
     from ray_tpu.core import rpc
 
     m = _gcs_call("get_metrics")
     if isinstance(m, dict):
         m.update(rpc.stats_snapshot())
+        # dev-mode sanitizer trips: this process's own counts are always
+        # visible here (like the rpc_* totals); cluster-wide sums ride the
+        # sanitizer_violations_total registry Counter through the normal
+        # metrics flush loops
+        counts = sanitizers.violation_counts()
+        if counts:
+            m["sanitizer_violations"] = counts
     return m
 
 

@@ -8,6 +8,12 @@ SURVEY.md §4). Must be set before jax import — hence module-level os.environ 
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Dev-mode runtime sanitizers (ray_tpu/analysis/sanitizers.py) are ON for
+# the whole tier-1 suite: lock-order cycle detection over the named
+# core-plane locks, the io-loop watchdog, thread-affinity assertions.
+# Must be set before any ray_tpu import (the gate is read at import time)
+# and inherits into every daemon/worker subprocess the tests spawn.
+os.environ.setdefault("RAY_TPU_SANITIZE", "1")
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
@@ -36,6 +42,40 @@ def pytest_configure(config):
         "guard so a regression that re-introduces a hang fails fast "
         "instead of stalling the whole suite.",
     )
+    config.addinivalue_line(
+        "markers",
+        "lint: raylint static-analysis gate (whole-package run asserting "
+        "zero unsuppressed findings) — one test node, selectable with "
+        "-m lint.",
+    )
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Sanitizer verdict for the whole suite: the driver process's own
+    violations print here; daemon-side trips surface through the
+    sanitizer_violations_total metric (scripts metrics / dashboards)."""
+    try:
+        from ray_tpu.analysis import sanitizers
+    except Exception:  # noqa: BLE001 - never break reporting
+        return
+    terminalreporter.write_line(
+        "raylint " + sanitizers.report(),
+        red=bool(sanitizers.violation_counts()),
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Deterministic sanitizer classes (lock-order cycles, affinity
+    breaks) fail the run outright — they are real bugs wherever they
+    fire. Loop stalls only print: on an oversubscribed CI box a slow
+    thread schedule can legitimately delay a heartbeat."""
+    try:
+        from ray_tpu.analysis import sanitizers
+    except Exception:  # noqa: BLE001
+        return
+    counts = sanitizers.violation_counts()
+    if counts.get("lock_order") or counts.get("affinity"):
+        session.exitstatus = 1
 
 
 @pytest.hookimpl(hookwrapper=True)
