@@ -55,6 +55,7 @@ from ray_tpu.cgraph.channel import (
 from ray_tpu.cgraph.compiled_dag import (
     CompiledDAG,
     CompiledDAGRef,
+    CompiledGraphError,
     actor_in_compiled_graph,
     compile_dag,
 )
@@ -63,6 +64,7 @@ from ray_tpu.cgraph.net_channel import NetChannel
 __all__ = [
     "CompiledDAG",
     "CompiledDAGRef",
+    "CompiledGraphError",
     "compile_dag",
     "actor_in_compiled_graph",
     "ChannelClosedError",

@@ -173,16 +173,21 @@ class ShmChannel:
         cap = self.capacity
         backoff = _Backoff()
         while True:
-            self._check_deadline(deadline, "write")
+            # try-first, deadline-after: write(timeout=0) is a legitimate
+            # non-blocking attempt (the serve fast path / async dispatch
+            # probe a channel without committing to a wait)
+            self._check_deadline(None, "write")  # closed check only
             wpos = self._u64(_OFF_WPOS)
             rpos = self._u64(_OFF_RPOS)
             if self._u64(_OFF_WSEQ) - self._u64(_OFF_RSEQ) >= self.max_msgs:
+                self._check_deadline(deadline, "write")
                 backoff.pause()
                 continue
             off = wpos % cap
             contig = cap - off
             total = need if contig >= need else contig + need
             if cap - (wpos - rpos) < total:
+                self._check_deadline(deadline, "write")
                 backoff.pause()
                 continue
             if contig < need:

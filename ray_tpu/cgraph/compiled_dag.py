@@ -100,6 +100,16 @@ def actor_in_compiled_graph(actor_handle) -> bool:
         return actor_handle._actor_id.binary() in _actors_in_use
 
 
+class CompiledGraphError(RuntimeError):
+    """The GRAPH itself is unusable (loop died without a classifiable actor
+    death, torn down, misaligned, result evicted) — as opposed to an error
+    the user's node code raised, which re-raises as its own type at
+    ``ref.get()``. A distinct type so framework callers (the serve fast
+    path's drainer) can demote/fail over on graph-infrastructure failures
+    without pattern-matching user exceptions; subclasses RuntimeError for
+    backward compatibility with existing callers."""
+
+
 class _RecoverNeeded(Exception):
     """Internal: a recoverable participant failure was detected and the
     graph was compiled with auto_recover=True — run recover() and retry."""
@@ -706,12 +716,12 @@ class CompiledDAG:
                     self._classify_failure()
                 if isinstance(e, ChannelSeveredError):
                     self._on_channel_severed(str(e))
-                raise RuntimeError(
+                raise CompiledGraphError(
                     "compiled graph execution loop died"
                 ) from e
             exited_early = True
         if exited_early:
-            raise RuntimeError(
+            raise CompiledGraphError(
                 "a compiled graph execution loop exited early "
                 "(actor torn down?)"
             )
@@ -776,23 +786,29 @@ class CompiledDAG:
                     # bounded write slices with loop-death probes between
                     # them (mirrors _get_result): a dead stage never closes
                     # the ring, so a full input channel would otherwise
-                    # block a timeout=None execute forever
+                    # block a timeout=None execute forever. Attempt-first:
+                    # execute(timeout=0) is a NON-BLOCKING try (one write
+                    # attempt, typed ChannelTimeoutError when full) — the
+                    # serve fast path and async dispatch probe with it.
                     while True:
                         remaining = (
                             None if deadline is None
                             else deadline - _time.monotonic()
                         )
-                        if remaining is not None and remaining <= 0:
-                            self._probe_failure()
-                            raise ChannelTimeoutError(
-                                "execute() input write timed out"
-                            )
-                        step = probe if remaining is None else min(remaining, probe)
+                        step = (
+                            probe if remaining is None
+                            else min(max(remaining, 0.0), probe)
+                        )
                         try:
                             ch.write((ex.VAL, v), timeout=step)
                             break
                         except ChannelTimeoutError:
                             self._probe_failure()
+                            if (deadline is not None
+                                    and deadline - _time.monotonic() <= 0):
+                                raise ChannelTimeoutError(
+                                    "execute() input write timed out"
+                                ) from None
                         except ChannelSeveredError as e:
                             # the partially-written seq dies with the old
                             # channels; recover() re-materializes them
@@ -831,13 +847,13 @@ class CompiledDAG:
 
     def _check_usable(self):
         if self._torn_down:
-            raise RuntimeError("compiled graph was torn down")
+            raise CompiledGraphError("compiled graph was torn down")
         if self._failure_event.is_set():
             self._classify_failure()
         if self._severed:
             self._on_channel_severed(self._severed)
         if self._broken:
-            raise RuntimeError(self._broken)
+            raise CompiledGraphError(self._broken)
 
     def _discard_result(self, seq: int) -> None:
         """A CompiledDAGRef was GC'd without get(): drop its buffered (or
@@ -898,9 +914,9 @@ class CompiledDAG:
             # before a participant died is still readable from the output
             # rings — only a BLOCKED read should classify the failure
             if self._torn_down:
-                raise RuntimeError("compiled graph was torn down")
+                raise CompiledGraphError("compiled graph was torn down")
             if self._broken:
-                raise RuntimeError(self._broken)
+                raise CompiledGraphError(self._broken)
             if seq >= self._submitted:
                 raise ValueError(f"seq {seq} was never submitted")
             deadline = None if timeout is None else _time.monotonic() + timeout
@@ -944,7 +960,7 @@ class CompiledDAG:
             entry = self._results.pop(seq, None)
             self._issued_refs.pop(seq, None)
             if entry is None:
-                raise RuntimeError(
+                raise CompiledGraphError(
                     f"result for seq {seq} already consumed, or evicted by "
                     "the cgraph_result_cache_limit backstop"
                 )
@@ -984,7 +1000,7 @@ class CompiledDAG:
         )
         with self._exec_lock, self._read_lock:
             if self._torn_down:
-                raise RuntimeError("compiled graph was torn down")
+                raise CompiledGraphError("compiled graph was torn down")
             if not self._failed and not self._severed:
                 return self
             # 0) salvage results already sitting in the output rings: a seq
@@ -1010,7 +1026,7 @@ class CompiledDAG:
             # _flag_lock, by design): materializing now would resurrect
             # loops and rings nothing will ever stop
             if self._torn_down:
-                raise RuntimeError("compiled graph was torn down")
+                raise CompiledGraphError("compiled graph was torn down")
             # 2) retire the old epoch: closing unblocks surviving loops
             # (they exit with ChannelClosedError); join best-effort
             for ch in self._channels:

@@ -70,16 +70,23 @@ class Config:
     # one-at-a-time via the worker's run slot; a task blocked in get() (or a
     # stream credit wait) hands its slot to the next queued task — the
     # in-process analog of the raylet's blocked-worker resource release — so
-    # tasks-that-get-tasks make progress under pipelining. Set 1 to disable
-    # sharing (tasks that block OUTSIDE get(), e.g. on out-of-band rendezvous,
-    # can still stall a queued peer).
+    # tasks-that-get-tasks make progress under pipelining. Tasks that block
+    # OUTSIDE get() (e.g. on out-of-band rendezvous) no longer require
+    # setting this to 1: work stealing migrates their queued peers to idle
+    # workers (worker_stealing_enabled).
     worker_max_tasks_in_flight: int = 10
     # bounded commitment for pipelined pushes: a pushed task that cannot
     # START executing within this window bounces back ({"requeue": True})
-    # and the owner resubmits it to another worker (poor-man's work
-    # stealing — keeps a task queued behind a long/blocking peer from
-    # being stuck there forever)
+    # and the owner resubmits it to another worker — the FALLBACK bound
+    # behind work stealing (a steal bounces the task the moment an idle
+    # worker shows up, this timer covers the no-idle-worker case)
     worker_requeue_after_ms: int = 200
+    # pipelined-task work stealing: when a leased worker goes fully idle,
+    # the owner asks its most-loaded leased worker (same scheduling key) to
+    # give back queued-but-not-started specs, which resubmit to the idle
+    # worker immediately instead of waiting out worker_requeue_after_ms
+    # behind a long/out-of-band-blocking task
+    worker_stealing_enabled: bool = True
 
     # --- object store -------------------------------------------------------
     object_store_memory_mb: int = 2048
@@ -94,8 +101,19 @@ class Config:
     # flushes immediately instead of waiting for the tick (latency bound)
     rpc_max_coalesce_bytes: int = 256 * 1024
     # extra gather window before a scheduled flush (0 = next loop tick);
-    # raising it trades per-frame latency for bigger gather-writes
+    # raising it trades per-frame latency for bigger gather-writes. With
+    # adaptive coalescing on, this is the floor every connection gets; busy
+    # connections stretch it up to rpc_adaptive_coalesce_max_ms.
     rpc_coalesce_delay_ms: float = 0.0
+    # per-connection adaptive coalescing: a connection whose recent flushes
+    # carried many frames each (an EWMA over the last flushes) delays its
+    # next flush up to rpc_adaptive_coalesce_max_ms to gather a bigger
+    # write; idle / request-response connections keep flushing immediately
+    rpc_adaptive_coalesce: bool = True
+    rpc_adaptive_coalesce_max_ms: float = 0.5
+    # EWMA frames-per-flush at which a connection counts as busy enough to
+    # trade latency for gather size
+    rpc_adaptive_coalesce_min_frames: float = 6.0
     # backpressure: _send blocks once this many un-flushed bytes are queued
     # on one connection (bounds memory under a slow/stalled peer)
     rpc_max_outstanding_bytes: int = 64 * 1024 * 1024
@@ -197,6 +215,32 @@ class Config:
     # a completed call slower than this counts as a breaker failure
     # (0 = slow-call detection off)
     serve_circuit_slow_call_ms: float = 0.0
+
+    # --- serve fast-path dispatch (compiled/transport plane) ----------------
+    # steady-state unary serve traffic dispatches over router-managed
+    # compiled channels (cgraph shm/NetChannel) instead of per-request task
+    # submission; the router keeps the slow path for cold start, streaming,
+    # failover and admission-shed requests
+    serve_fastpath_enabled: bool = True
+    # successful routed dispatches to one (deployment, replica) pair before
+    # the router warms a compiled channel for it (cold/bursty deployments
+    # never pay the compile)
+    serve_fastpath_warmup_requests: int = 32
+    # pipelining depth of each fast-path channel (compiled-graph
+    # max_in_flight); dispatch falls back to the slow path when full
+    serve_fastpath_max_in_flight: int = 32
+    # only pairs whose recent request latency (EWMA, ms) stays under this
+    # warm a channel: slow handlers gain nothing from faster dispatch and
+    # lose replica-side concurrency to the (serial) graph loop
+    serve_fastpath_max_latency_ms: float = 25.0
+    # after a fast-path failure (severed channel, replica death, failed
+    # compile) the pair stays demoted to the slow path this long
+    serve_fastpath_cooldown_s: float = 5.0
+    # per-replica cap on concurrently-open streaming responses: a stream
+    # stops debiting unary admission once its header arrives, so without a
+    # cap stream fan-out could occupy every replica thread and starve
+    # unary requests. 0 disables. Per-deployment: max_ongoing_streams.
+    serve_max_ongoing_streams: int = 64
 
     # --- streaming generators ----------------------------------------------
     # un-acked stream_item pushes a producing worker keeps in flight when no

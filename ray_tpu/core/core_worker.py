@@ -102,8 +102,8 @@ class _MemoryStore:
         self._events.pop(oid, None)
 
 
-@dataclass
-class _LeaseEntry:
+@dataclass(eq=False)  # identity semantics: hashable for the pool's WeakSet,
+class _LeaseEntry:    # and list.remove can never conflate two same-shaped leases
     """One cached worker lease (scheduling-key lease reuse).
 
     A lease admits up to ``max_tasks_in_flight_per_worker`` concurrent
@@ -140,7 +140,15 @@ class _LeasePool:
         self.backlog = 0  # submitters currently inside _acquire_lease
         self.batch_inflight = False  # one opportunistic batch request at a time
         self.last_kick = 0.0  # last backlog-sized batch request (cooldown)
+        self.last_steal = 0.0  # work-stealing trigger cooldown
         self.error: Optional[BaseException] = None  # latest failed request
+        # every live entry of this key, including full-window ones that left
+        # pool.idle — the work-stealing trigger needs to see busy victims
+        # (weak: an entry is alive while pool.idle or an in-flight
+        # submission holds it)
+        import weakref
+
+        self.entries: "weakref.WeakSet" = weakref.WeakSet()
         from collections import deque
 
         self._waiters: "deque" = deque()
@@ -1289,7 +1297,48 @@ class CoreWorker:
         if not entry.dropped and not entry.pooled:
             entry.pooled = True
             pool.idle.append(entry)
+        if not entry.dropped:
+            pool.entries.add(entry)
+            if entry.inflight == 0:
+                # this worker just went fully idle: reclaim queued specs
+                # stuck behind a busy peer so they run HERE instead of
+                # waiting out worker_requeue_after_ms
+                self._maybe_steal(pool, entry)
         pool.wake()
+
+    def _maybe_steal(self, pool: "_LeasePool", idle_entry: "_LeaseEntry"):
+        """Work stealing (owner-side trigger): an idle leased worker +
+        a same-key peer with queued (inflight >= 2) specs means those specs
+        are pointlessly serialized — ask the most-loaded peer to bounce its
+        queued-but-not-started specs; each bounce resubmits through
+        _submit_once and lands on the idle entry."""
+        if not _config.worker_stealing_enabled:
+            return
+        now = time.monotonic()
+        if now - pool.last_steal < 0.005:
+            return
+        victim = None
+        for e in pool.entries:
+            if (e is idle_entry or e.dropped or e.inflight < 2
+                    or e.conn is None or e.conn.closed):
+                continue
+            if victim is None or e.inflight > victim.inflight:
+                victim = e
+        if victim is None:
+            return
+        pool.last_steal = now
+        n = victim.inflight - 1  # leave the running task in place
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:  # not on the io loop (shutdown path): skip
+            return
+        self._hold_bg(loop.create_task(self._send_steal(victim, n)))
+
+    async def _send_steal(self, victim: "_LeaseEntry", n: int):
+        try:
+            await victim.conn.notify("steal_tasks", n=n)
+        except Exception:  # noqa: BLE001 - advisory; requeue timer backstops
+            pass
 
     async def _acquire_lease(self, pool: "_LeasePool", spec) -> "_LeaseEntry":
         """Take an idle cached lease, or request a fresh one.
@@ -1528,6 +1577,7 @@ class CoreWorker:
             pool.wake()
             return
         entry.dropped = True
+        pool.entries.discard(entry)
         if entry.pooled:
             entry.pooled = False
             try:

@@ -343,6 +343,13 @@ class Connection:
         self._staged: List[tuple] = []    # requests staged for a BATCH frame
         self._flush_handle = None         # scheduled call_soon/call_later
         self._flusher: Optional[asyncio.Task] = None
+        # adaptive coalescing: EWMA of wire frames per flush. A connection
+        # that keeps putting many frames into each gather-write is a bulk
+        # path (reply fan-in, pipelined pushes) — it trades a bounded delay
+        # (rpc_adaptive_coalesce_max_ms) for even bigger writes; a
+        # request-response connection (EWMA ~1) keeps flushing on the next
+        # loop tick so its round-trip latency never pays the window.
+        self._flush_ewma = 0.0
         self._flushed_waiters: deque = deque()  # backpressure parks here
         self._enqueue_lock = asyncio.Lock()     # FIFO enqueue order
         self._loop: Optional[asyncio.AbstractEventLoop] = None  # set in start()
@@ -562,11 +569,22 @@ class Connection:
             return
         if self._flush_handle is None:
             loop = self._loop or asyncio.get_running_loop()
-            delay = _config.rpc_coalesce_delay_ms / 1000.0
+            delay = self._coalesce_delay_s()
             if delay > 0:
                 self._flush_handle = loop.call_later(delay, self._on_flush_timer)
             else:
                 self._flush_handle = loop.call_soon(self._on_flush_timer)
+
+    def _coalesce_delay_s(self) -> float:
+        """Per-connection gather window before the scheduled flush:
+        the configured floor, stretched to rpc_adaptive_coalesce_max_ms
+        while this connection's recent flushes ran busy (EWMA frames/flush
+        over rpc_adaptive_coalesce_min_frames)."""
+        delay = _config.rpc_coalesce_delay_ms / 1000.0
+        if (_config.rpc_adaptive_coalesce
+                and self._flush_ewma >= _config.rpc_adaptive_coalesce_min_frames):
+            delay = max(delay, _config.rpc_adaptive_coalesce_max_ms / 1000.0)
+        return delay
 
     def _on_flush_timer(self) -> None:
         self._flush_handle = None
@@ -627,6 +645,10 @@ class Connection:
             chunks = self._outbox
             nbytes, nframes = self._outbox_bytes, self._outbox_frames
             self._outbox, self._outbox_bytes, self._outbox_frames = [], 0, 0
+            # busy-ness signal for the adaptive gather window (wire frames
+            # per flush; BATCH frames count once — they are already one
+            # gather-write, so batched submit paths never read as busy)
+            self._flush_ewma = 0.75 * self._flush_ewma + 0.25 * nframes
             self._wake_flushed()
             t0 = time.perf_counter()
             try:

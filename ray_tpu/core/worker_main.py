@@ -31,6 +31,72 @@ from ray_tpu.core.ids import ObjectID
 logger = logging.getLogger(__name__)
 
 
+class _StealableRunSlot:
+    """The plain-task execution slot, with work stealing.
+
+    One task RUNS at a time (the slot); tasks pushed behind it WAIT here.
+    An owner that sees another of its leased workers go idle sends
+    ``steal_tasks`` — waiting (queued, never-started) tasks are marked
+    stolen and bounce back ``{"requeue": True}`` immediately, so a spec
+    committed to a busy worker migrates to the idle one instead of waiting
+    out ``worker_requeue_after_ms`` behind a long/out-of-band-blocking
+    task. A task that already holds the slot can never be stolen."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._held = False
+        # task_id hex -> stolen flag, insertion-ordered (steal takes the
+        # NEWEST waiters: they are the furthest from running)
+        self._waiters: Dict[str, bool] = {}
+        self.steals = 0  # lifetime stolen-task count (stats/tests)
+
+    def acquire_for(self, task_id: str, timeout: float) -> str:
+        """Wait for the slot as task ``task_id``; returns "acquired",
+        "stolen" (an owner reclaimed this spec) or "timeout"."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cv:
+            self._waiters[task_id] = False
+            try:
+                while True:
+                    if self._waiters[task_id]:
+                        return "stolen"
+                    if not self._held:
+                        self._held = True
+                        return "acquired"
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return "timeout"
+                    self._cv.wait(remaining)
+            finally:
+                self._waiters.pop(task_id, None)
+
+    def acquire(self) -> None:
+        """Unconditional re-take (the yield-slot path resuming a blocked
+        task); never steals, never times out."""
+        with self._cv:
+            while self._held:
+                self._cv.wait()
+            self._held = True
+
+    def release(self) -> None:
+        with self._cv:
+            self._held = False
+            self._cv.notify_all()
+
+    def steal(self, n: int) -> int:
+        """Mark up to ``n`` waiting tasks stolen (newest first); they bounce
+        back to their owner for resubmission elsewhere."""
+        with self._cv:
+            pending = [t for t, stolen in self._waiters.items() if not stolen]
+            take = pending[-max(0, n):] if n > 0 else []
+            for tid in take:
+                self._waiters[tid] = True
+            if take:
+                self.steals += len(take)
+                self._cv.notify_all()
+            return len(take)
+
+
 class WorkerAgent(CoreWorker):
     def __init__(self, gcs_address, raylet_address, session, node_id):
         super().__init__(gcs_address, raylet_address, session, node_id, mode="worker")
@@ -44,7 +110,7 @@ class WorkerAgent(CoreWorker):
         self._exec_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="task-exec"
         )
-        self._exec_slot = threading.Semaphore(1)
+        self._exec_slot = _StealableRunSlot()
         self._slot_state = threading.local()
         # actor state
         self.actor_instance = None
@@ -83,23 +149,34 @@ class WorkerAgent(CoreWorker):
         loop = asyncio.get_running_loop()
         if spec.streaming:
             return await loop.run_in_executor(
-                self._exec_pool, self._run_slotted,
+                self._exec_pool, self._run_slotted, spec,
                 self._execute_streaming, spec, conn,
             )
         return await loop.run_in_executor(
-            self._exec_pool, self._run_slotted, self._execute, spec
+            self._exec_pool, self._run_slotted, spec, self._execute, spec
         )
 
-    def _run_slotted(self, fn, *args):
+    async def handle_steal_tasks(self, conn, n=1):
+        """An owner with an idle leased worker reclaims queued-but-not-
+        started specs from this (busy) one; each stolen spec's push_task
+        reply bounces ``{"requeue": True}`` and the owner resubmits it to
+        the idle worker."""
+        return {"stolen": self._exec_slot.steal(int(n))}
+
+    def _run_slotted(self, spec, fn, *args):
         """Run one pushed task under the single execution slot. The slot —
         not the pool width — is what keeps plain-task execution serial;
         get_blocking hands it over for the duration of a blocking get.
-        A task that cannot take the slot within worker_requeue_after_ms
-        bounces back to the owner for resubmission elsewhere (bounded
-        commitment: a long/blocking peer must not pin queued tasks)."""
-        if not self._exec_slot.acquire(
-                timeout=max(0.0, _config.worker_requeue_after_ms) / 1000.0):
-            return {"requeue": True}
+        A queued task bounces back to the owner ({"requeue": True}) either
+        when an owner STEALS it for an idle worker (immediate) or after
+        worker_requeue_after_ms (fallback bound when no worker is idle) —
+        a long/blocking peer must not pin queued tasks."""
+        outcome = self._exec_slot.acquire_for(
+            spec.task_id.hex(),
+            max(0.0, _config.worker_requeue_after_ms) / 1000.0,
+        )
+        if outcome != "acquired":
+            return {"requeue": True, "why": outcome}
         self._slot_state.held = True
         try:
             return fn(*args)

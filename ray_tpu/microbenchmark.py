@@ -10,9 +10,53 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, List
 
 import numpy as np
+
+# Every row name main() emits, in order. The tier-1 smoke test runs
+# `python -m ray_tpu.microbenchmark --smoke --json <path>` (tiny durations,
+# no perf assertions) and checks the emitted set against this registry, so
+# a renamed/dropped row — the drift that silently breaks MICROBENCH.json
+# comparisons across PRs — fails CI instead of landing unnoticed.
+EXPECTED_ROWS: List[str] = [
+    "put small (1 KiB)",
+    "put small (batched x64)",
+    "get small (1 KiB)",
+    "put large (10 MiB)",
+    "get large (10 MiB, zero-copy)",
+    "task submit+get (sync, 1 in flight)",
+    "task throughput (50 in flight)",
+    "task inflight/sync ratio",
+    "actor call (sync, 1 in flight)",
+    "actor calls (100 in flight, pipelined)",
+    "actor calls (100 in flight, coalesced wire)",
+    "dag interpreted execute (3-stage actor)",
+    "dag compiled execute (3-stage actor)",
+    "dag compiled execute (pipelined submission)",
+    "stream chunks polling next_chunk (cluster)",
+    "stream chunks push generator (cluster)",
+    "stream chunks polling next_chunk (local)",
+    "stream chunks push generator (local)",
+    "task dispatch (50 in flight), tracing off",
+    "task dispatch (50 in flight), tracing sampled 10%",
+    "task dispatch (50 in flight), tracing full",
+    "serve dispatch (20 in flight), metrics off, wal off",
+    "serve dispatch (20 in flight), metrics on, wal off",
+    "serve dispatch (20 in flight), metrics on, wal on",
+    "serve dispatch (20 in flight), metrics on, fast path off",
+    "pipelined tasks behind a blocker (steal on)",
+    "pipelined tasks behind a blocker (steal off)",
+    "task throughput (50 in flight, fixed coalesce)",
+    "actor calls (100 in flight, fixed coalesce)",
+    "overload shed latency p99 ms (admission on)",
+    "overload accepted p99 ms (admission on)",
+    "overload queued p99 ms (admission off)",
+    "overload shed/accepted counts (admission on)",
+    "dag cross-node interpreted execute (2 nodes)",
+    "dag cross-node compiled execute (2 nodes)",
+    "dag cross-node compiled (pipelined, 2 nodes)",
+]
 
 
 def timeit(name: str, fn: Callable[[], int], duration: float = 2.0) -> Dict:
@@ -29,9 +73,13 @@ def timeit(name: str, fn: Callable[[], int], duration: float = 2.0) -> Dict:
     return {"name": name, "ops_per_s": round(rate, 1)}
 
 
-def main(duration: float = 2.0, json_path: str = ""):
+def main(duration: float = 2.0, json_path: str = "", smoke: bool = False):
     import ray_tpu
 
+    if smoke:
+        # schema-check mode: every section runs on a tiny config so the
+        # full row set is emitted in tier-1 time; numbers are meaningless
+        duration = min(duration, 0.05)
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=4, num_tpus=0)
     results = []
@@ -187,20 +235,26 @@ def main(duration: float = 2.0, json_path: str = ""):
     compiled.teardown()
 
     # --------------------------------------------- streaming generators
-    _stream_benchmarks(ray_tpu, results, "cluster", duration)
+    _stream_benchmarks(ray_tpu, results, "cluster", duration, smoke)
 
     ray_tpu.shutdown()
 
     # local-mode pass: same polling-vs-push pair on the in-process backend
     ray_tpu.init(local_mode=True)
-    _stream_benchmarks(ray_tpu, results, "local", duration)
+    _stream_benchmarks(ray_tpu, results, "local", duration, smoke)
     ray_tpu.shutdown()
 
     # ----------------------------------------------------- tracing overhead
     _tracing_overhead_benchmarks(ray_tpu, results, duration)
 
-    # ----------------------------------------------------- metrics overhead
-    _metrics_overhead_benchmarks(ray_tpu, results, duration)
+    # ------------------------------------------- serve dispatch (fast path)
+    _metrics_overhead_benchmarks(ray_tpu, results, duration, smoke)
+
+    # ----------------------------------------------------- work stealing
+    _stealing_benchmarks(ray_tpu, results, smoke)
+
+    # ------------------------------------------------- adaptive coalescing
+    _dispatch_knob_benchmarks(ray_tpu, results, duration)
 
     # ------------------------------------------------------------- overload
     _overload_benchmarks(ray_tpu, results, duration)
@@ -302,7 +356,8 @@ def _chunk_source(n):
     return gen()
 
 
-def _stream_benchmarks(ray_tpu, results, mode: str, duration: float):
+def _stream_benchmarks(ray_tpu, results, mode: str, duration: float,
+                       smoke: bool = False):
     """Chunk throughput: the legacy polling protocol (one next_chunk actor
     RPC round trip per chunk against a ServeReplica sid registry) vs the
     push-based streaming-generator subsystem (num_returns="streaming",
@@ -314,7 +369,7 @@ def _stream_benchmarks(ray_tpu, results, mode: str, duration: float):
     rep = Replica.remote(_chunk_source, (), {})
 
     def poll_chunks():
-        n = 100
+        n = 20 if smoke else 100
         marker = ray_tpu.get(rep.handle_request.remote(n), timeout=60)
         sid = marker["__serve_stream__"]
         got = 0
@@ -338,7 +393,7 @@ def _stream_benchmarks(ray_tpu, results, mode: str, duration: float):
     s = Streamer.remote()
 
     def push_chunks():
-        n = 500
+        n = 50 if smoke else 500
         got = 0
         gen = s.chunks.options(num_returns="streaming").remote(n)
         for ref in gen:
@@ -404,12 +459,15 @@ def _tracing_overhead_benchmarks(ray_tpu, results, duration: float):
         _config.task_events_enabled, _config.task_events_sample_rate = saved_cfg
 
 
-def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
-    """Serve dispatch throughput with the SLO instrumentation plane (router
-    + replica histograms/counters) and the task-event WAL off and on. Each
-    pass boots a fresh cluster with the config in the environment, so the
-    replica workers honor it too. The PR-8 acceptance bar: instrumentation
-    overhead within box noise on the serve dispatch row."""
+def _metrics_overhead_benchmarks(ray_tpu, results, duration: float,
+                                 smoke: bool = False):
+    """Serve dispatch throughput across the SLO instrumentation plane
+    (metrics/WAL off and on — the PR-8 acceptance bar: within box noise)
+    and the compiled fast path (on by default; the "fast path off" row is
+    the router slow-path baseline — the PR-13 acceptance bar: the default
+    rows beat it by ~2x). Each pass boots a fresh cluster with the config
+    in the environment, so replica workers honor it too; fast-path passes
+    warm the channel BEFORE timing (steady-state is what the row claims)."""
     import os
 
     from ray_tpu.core.config import _config
@@ -417,21 +475,28 @@ def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
     saved_env = {
         k: os.environ.get(k)
         for k in ("RAY_TPU_METRICS_ENABLED",
-                  "RAY_TPU_TASK_EVENTS_WAL_ENABLED")
+                  "RAY_TPU_TASK_EVENTS_WAL_ENABLED",
+                  "RAY_TPU_SERVE_FASTPATH_ENABLED")
     }
-    saved_cfg = (_config.metrics_enabled, _config.task_events_wal_enabled)
+    saved_cfg = (_config.metrics_enabled, _config.task_events_wal_enabled,
+                 _config.serve_fastpath_enabled)
     try:
-        for label, metrics_on, wal_on in (
-            ("metrics off, wal off", False, False),
-            ("metrics on, wal off", True, False),
-            ("metrics on, wal on", True, True),
+        for label, metrics_on, wal_on, fastpath_on in (
+            ("metrics off, wal off", False, False, True),
+            ("metrics on, wal off", True, False, True),
+            ("metrics on, wal on", True, True, True),
+            ("metrics on, fast path off", True, False, False),
         ):
             os.environ["RAY_TPU_METRICS_ENABLED"] = "1" if metrics_on else "0"
             os.environ["RAY_TPU_TASK_EVENTS_WAL_ENABLED"] = (
                 "1" if wal_on else "0"
             )
+            os.environ["RAY_TPU_SERVE_FASTPATH_ENABLED"] = (
+                "1" if fastpath_on else "0"
+            )
             _config.metrics_enabled = metrics_on
             _config.task_events_wal_enabled = wal_on
+            _config.serve_fastpath_enabled = fastpath_on
             ray_tpu.init(num_cpus=4, num_tpus=0)
             from ray_tpu import serve
 
@@ -443,6 +508,18 @@ def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
             try:
                 handle = serve.run(Echo.bind())
                 assert ray_tpu.get(handle.remote(0), timeout=60) == 0
+                # steady state: cross the fast-path warmup threshold and
+                # wait for the background compile before the clock starts
+                for i in range(_config.serve_fastpath_warmup_requests + 8):
+                    ray_tpu.get(handle.remote(i), timeout=60)
+                if fastpath_on:
+                    wait_until = time.monotonic() + (8 if smoke else 30)
+                    while time.monotonic() < wait_until:
+                        if handle._router._fastpath.ready_deployments().get(
+                                "Echo"):
+                            break
+                        ray_tpu.get(handle.remote(0), timeout=60)
+                        time.sleep(0.02)
 
                 def serve_dispatch():
                     n = 20
@@ -451,10 +528,18 @@ def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
                         ray_tpu.get(r, timeout=60)
                     return n
 
-                results.append(timeit(
-                    f"serve dispatch (20 in flight), {label}",
-                    serve_dispatch, duration,
-                ))
+                # median of three windows: the CI box is a shared single
+                # CPU and a host-side hiccup landing inside one window has
+                # repeatedly cratered a single serve row by 5-10x while
+                # its neighbors measured fine — the median discards one
+                # bad window without inventing numbers
+                name = f"serve dispatch (20 in flight), {label}"
+                windows = [
+                    timeit(name, serve_dispatch, duration)
+                    for _ in range(1 if smoke else 3)
+                ]
+                windows.sort(key=lambda r: r["ops_per_s"])
+                results.append(windows[len(windows) // 2])
             finally:
                 serve.shutdown()
                 ray_tpu.shutdown()
@@ -464,7 +549,132 @@ def _metrics_overhead_benchmarks(ray_tpu, results, duration: float):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-        _config.metrics_enabled, _config.task_events_wal_enabled = saved_cfg
+        (_config.metrics_enabled, _config.task_events_wal_enabled,
+         _config.serve_fastpath_enabled) = saved_cfg
+
+
+def _stealing_benchmarks(ray_tpu, results, smoke: bool = False):
+    """Pipelined-task work stealing: a task blocking OUT-OF-BAND (plain
+    sleep — it never yields its run slot) pins its worker; quick tasks
+    queued behind it must migrate to the idle worker. Measured as the
+    wall-clock to drain the quick tasks, steal on vs off (off = they wait
+    out worker_requeue_after_ms or the blocker, whichever ends first).
+    A fresh 2-CPU cluster per pass so workers read the knob from the
+    environment."""
+    import os
+    import statistics
+
+    from ray_tpu.core.config import _config
+
+    saved = os.environ.get("RAY_TPU_WORKER_STEALING_ENABLED")
+    saved_cfg = _config.worker_stealing_enabled
+    block_s = 0.1 if smoke else 0.4
+    rounds = 2 if smoke else 5
+    try:
+        for label, stealing in (("steal on", True), ("steal off", False)):
+            os.environ["RAY_TPU_WORKER_STEALING_ENABLED"] = (
+                "1" if stealing else "0"
+            )
+            _config.worker_stealing_enabled = stealing
+            ray_tpu.init(num_cpus=2, num_tpus=0)
+            try:
+                @ray_tpu.remote
+                def blocker(s):
+                    time.sleep(s)
+                    return "done"
+
+                @ray_tpu.remote
+                def quick(i):
+                    return i
+
+                ray_tpu.get([quick.remote(i) for i in range(8)], timeout=60)
+                drains = []
+                for _ in range(rounds):
+                    b = blocker.remote(block_s)
+                    time.sleep(0.02)  # let it take a run slot
+                    t0 = time.perf_counter()
+                    out = ray_tpu.get(
+                        [quick.remote(i) for i in range(16)], timeout=60
+                    )
+                    drains.append((time.perf_counter() - t0) * 1000)
+                    assert out == list(range(16))
+                    ray_tpu.get(b, timeout=60)
+                ms = statistics.median(drains)
+                name = f"pipelined tasks behind a blocker ({label})"
+                print(f"{name:<50s} {ms:>10.2f} ms")
+                results.append({"name": name, "ms": round(ms, 2)})
+            finally:
+                ray_tpu.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_WORKER_STEALING_ENABLED", None)
+        else:
+            os.environ["RAY_TPU_WORKER_STEALING_ENABLED"] = saved
+        _config.worker_stealing_enabled = saved_cfg
+
+
+def _dispatch_knob_benchmarks(ray_tpu, results, duration: float):
+    """Adaptive per-connection coalescing baseline: the default task/actor
+    burst rows run with the adaptive gather window ON; this pass pins
+    rpc_adaptive_coalesce off (fixed rpc_coalesce_delay_ms only) on a
+    fresh cluster, so the pair of rows records what the knob buys on the
+    reply fan-in path."""
+    import os
+
+    from ray_tpu.core.config import _config
+
+    saved = os.environ.get("RAY_TPU_RPC_ADAPTIVE_COALESCE")
+    saved_cfg = _config.rpc_adaptive_coalesce
+    try:
+        os.environ["RAY_TPU_RPC_ADAPTIVE_COALESCE"] = "0"
+        _config.rpc_adaptive_coalesce = False
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        try:
+            @ray_tpu.remote
+            def noop():
+                return 0
+
+            ray_tpu.get([noop.remote() for _ in range(16)], timeout=60)
+
+            def batch_tasks():
+                n = 50
+                ray_tpu.get([noop.remote() for _ in range(n)])
+                return n
+
+            results.append(timeit(
+                "task throughput (50 in flight, fixed coalesce)",
+                batch_tasks, duration,
+            ))
+
+            @ray_tpu.remote
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def inc(self):
+                    self.n += 1
+                    return self.n
+
+            actor = Counter.remote()
+            ray_tpu.get(actor.inc.remote(), timeout=60)
+
+            def batch_actor_calls():
+                n = 100
+                ray_tpu.get([actor.inc.remote() for _ in range(n)])
+                return n
+
+            results.append(timeit(
+                "actor calls (100 in flight, fixed coalesce)",
+                batch_actor_calls, duration,
+            ))
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        if saved is None:
+            os.environ.pop("RAY_TPU_RPC_ADAPTIVE_COALESCE", None)
+        else:
+            os.environ["RAY_TPU_RPC_ADAPTIVE_COALESCE"] = saved
+        _config.rpc_adaptive_coalesce = saved_cfg
 
 
 def _overload_benchmarks(ray_tpu, results, duration: float):
@@ -561,5 +771,9 @@ if __name__ == "__main__":
                     help="seconds per benchmark")
     ap.add_argument("--json", default="", metavar="PATH",
                     help="also write the results JSON to PATH")
+    ap.add_argument("--smoke", action="store_true",
+                    help="schema-check mode: tiny durations, every section "
+                         "runs and emits its rows (EXPECTED_ROWS); numbers "
+                         "are meaningless")
     ns = ap.parse_args()
-    main(duration=ns.duration, json_path=ns.json)
+    main(duration=ns.duration, json_path=ns.json, smoke=ns.smoke)

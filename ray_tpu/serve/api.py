@@ -127,8 +127,18 @@ def shutdown() -> None:
     import ray_tpu
 
     controller = _local.pop("controller", None)
-    _local.pop("router", None)
-    _local.pop("proxy", None)
+    router = _local.pop("router", None)
+    proxy = _local.pop("proxy", None)
+    if router is not None:
+        try:
+            router.close()  # tear down fast-path channels before replicas die
+        except Exception:  # noqa: BLE001
+            pass
+    if proxy is not None:
+        try:
+            proxy._router.close()
+        except Exception:  # noqa: BLE001
+            pass
     if controller is not None:
         try:
             ray_tpu.get(controller.shutdown.remote(), timeout=60)

@@ -271,6 +271,8 @@ class ServeController:
 
         from ray_tpu.serve.replica import ServeReplica
 
+        from ray_tpu.core.config import _config
+
         opts = dict(dep.ray_actor_options)
         opts.setdefault("num_cpus", 1)
         # +2 headroom over max_ongoing_requests: health checks/stats must
@@ -279,12 +281,19 @@ class ServeController:
         # slot lets the replica FAST-REJECT overflow typed
         # (BackPressureError) instead of silently queueing it — the
         # replica-side enforcement half of admission control. ServeReplica
-        # itself caps USER work at max_ongoing.
-        opts.setdefault("max_concurrency", dep.max_ongoing_requests + 2)
+        # itself caps USER work at max_ongoing. +1 more when the serve
+        # fast path can warm: its compiled-graph loop permanently occupies
+        # one thread, which must never be the health check's.
+        headroom = 3 if _config.serve_fastpath_enabled else 2
+        opts.setdefault("max_concurrency", dep.max_ongoing_requests + headroom)
         actor_cls = ray_tpu.remote(**opts)(ServeReplica)
+        streams = getattr(dep, "max_ongoing_streams", None)
         return actor_cls.remote(dep.func_or_class, dep.init_args,
                                 dep.init_kwargs, deployment_name=dep.name,
-                                max_ongoing=dep.max_ongoing_requests)
+                                max_ongoing=dep.max_ongoing_requests,
+                                max_ongoing_streams=(
+                                    -1 if streams is None else streams
+                                ))
 
     def _stop_replicas(self, actors):
         import ray_tpu

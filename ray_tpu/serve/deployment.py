@@ -45,6 +45,11 @@ class Deployment:
     # typed BackPressureError (HTTP 503 + Retry-After at the proxy).
     # None = _config.serve_max_queued_requests. Routing-table propagated.
     max_queued_requests: Optional[int] = None
+    # per-replica cap on concurrently-OPEN streaming responses (streams stop
+    # debiting unary admission after their header, so fan-out needs its own
+    # bound); overflow sheds typed BackPressureError at dispatch.
+    # None = _config.serve_max_ongoing_streams, 0 = off.
+    max_ongoing_streams: Optional[int] = None
 
     def options(self, **kwargs) -> "Deployment":
         return replace(self, **kwargs)
@@ -85,6 +90,7 @@ def deployment(
     request_timeout_s: Optional[float] = None,
     stream_backpressure_window: Optional[int] = None,
     max_queued_requests: Optional[int] = None,
+    max_ongoing_streams: Optional[int] = None,
 ):
     """@serve.deployment — wraps a class or function into a Deployment."""
 
@@ -104,6 +110,7 @@ def deployment(
             request_timeout_s=request_timeout_s,
             stream_backpressure_window=stream_backpressure_window,
             max_queued_requests=max_queued_requests,
+            max_ongoing_streams=max_ongoing_streams,
         )
 
     if _func_or_class is not None:

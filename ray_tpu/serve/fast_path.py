@@ -1,0 +1,403 @@
+"""Serve fast-path dispatch: steady-state traffic over the compiled plane.
+
+The router's slow path pays interpreted proxy→router→replica rpc hops per
+request; the compiled-graph plane (ray_tpu/cgraph/: pre-allocated shm rings
+on one host, NetChannel stream transport across hosts) already eliminated
+those hops for pipelines. This module makes that plane the DEFAULT data path
+for steady-state unary serve traffic:
+
+- every successful routed dispatch feeds a per-(deployment, replica) warmth
+  tracker; after ``serve_fastpath_warmup_requests`` successes with a recent
+  latency EWMA under ``serve_fastpath_max_latency_ms``, the pool compiles a
+  one-node graph over the replica's ``handle_request_fastpath`` entry point
+  in the background (traffic keeps flowing on the slow path meanwhile);
+- once warmed, ``Router.assign_request`` dispatches unary requests by
+  writing ``(deadline, trace_id, args, kwargs)`` into the channel —
+  admission, circuit breaking and deadline minting already happened at the
+  router, the replica re-enters the deadline/trace context and sheds
+  expired work typed, and a per-pair drainer thread fulfills the caller's
+  deferred ObjectRef so SLO metrics, breaker votes and inflight accounting
+  fire per request exactly like the routed path;
+- anything else stays on the slow path: cold/low-volume pairs, streaming,
+  admission-shed requests, failover retries, and requests that find the
+  channel full (``execute(timeout=0)`` is a non-blocking try);
+- a fast-path failure (severed channel, replica death) DEMOTES the pair for
+  ``serve_fastpath_cooldown_s`` and degrades the in-flight requests to the
+  router slow path through the existing budgeted-retry machinery — the
+  caller sees the same typed retry semantics as a routed replica death.
+
+The graph loop occupies one replica thread (the controller provisions
+``max_ongoing_requests + 2``), executes fast-path requests serially, and
+pipelines up to ``serve_fastpath_max_in_flight`` submissions — which is why
+warming is gated on latency: sub-ms handlers gain 2-3x dispatch throughput,
+while slow handlers keep the slow path's full replica concurrency.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.analysis import sanitizers as _san
+from ray_tpu.core.config import _config
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()  # drainer sentinel: queue drained -> teardown the graph
+
+
+class _Item:
+    """One in-flight fast-path request awaiting its drainer."""
+
+    __slots__ = ("ref", "fulfill", "deployment", "rkey", "replica", "args",
+                 "kwargs", "deadline", "trace_id", "dispatched_at")
+
+    def __init__(self, ref, fulfill, deployment, rkey, replica, args, kwargs,
+                 deadline, trace_id):
+        self.ref = ref
+        self.fulfill = fulfill
+        self.deployment = deployment
+        self.rkey = rkey
+        self.replica = replica
+        self.args = args
+        self.kwargs = kwargs
+        self.deadline = deadline
+        self.trace_id = trace_id
+        self.dispatched_at = time.monotonic()
+
+
+class _Pair:
+    """Warmth + channel state for one (deployment, replica)."""
+
+    __slots__ = ("state", "successes", "latency_ewma", "dag", "replica",
+                 "queue", "drainer", "demoted_until")
+
+    def __init__(self):
+        self.state = "cold"  # cold | warming | ready | demoted
+        self.successes = 0
+        self.latency_ewma: Optional[float] = None
+        self.dag = None
+        self.replica = None
+        self.queue: Optional[_queue.Queue] = None
+        self.drainer: Optional[threading.Thread] = None
+        self.demoted_until = 0.0
+
+
+class FastPathPool:
+    """Router-owned pool of compiled fast-path channels.
+
+    Locking: ``self._lock`` guards pair state only. The drainer calls back
+    into the Router (inflight accounting, breaker votes, budgeted retries)
+    with NO pool lock held; the Router calls in (``note_success``,
+    ``retain``, ``demote``) holding at most its own lock — pool methods
+    never take Router locks, so the order serve.router → serve.fastpath is
+    acyclic.
+    """
+
+    def __init__(self, router):
+        self._router = router
+        self._lock = _san.make_lock("serve.fastpath")
+        self._pairs: Dict[Tuple[str, bytes], _Pair] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------- warmth
+    def note_success(self, deployment: str, rkey: bytes, replica,
+                     latency_ms: float) -> None:
+        """Feed one successful routed completion; warms the pair once it
+        qualifies (volume + latency). Called off the completion callback —
+        must stay cheap."""
+        if self._closed or not _config.serve_fastpath_enabled:
+            return
+        if _config.serve_request_retries <= 0:
+            # the fast path fulfills DEFERRED refs; with retries disabled
+            # assign_request never creates one, so a warmed channel would
+            # pin a replica thread + a drainer and carry zero requests
+            return
+        key = (deployment, rkey)
+        warm = False
+        with self._lock:
+            p = self._pairs.get(key)
+            if p is None:
+                p = self._pairs[key] = _Pair()
+            p.latency_ewma = (
+                latency_ms if p.latency_ewma is None
+                else 0.8 * p.latency_ewma + 0.2 * latency_ms
+            )
+            if p.state == "demoted" and time.monotonic() >= p.demoted_until:
+                p.state = "cold"
+                p.successes = 0
+            if p.state != "cold":
+                return
+            p.successes += 1
+            if (p.successes >= _config.serve_fastpath_warmup_requests
+                    and p.latency_ewma <= _config.serve_fastpath_max_latency_ms):
+                p.state = "warming"
+                p.replica = replica
+                warm = True
+        if warm:
+            threading.Thread(
+                target=self._warm, args=(key, replica),
+                name=f"serve-fastpath-warm-{deployment}", daemon=True,
+            ).start()
+
+    def _warm(self, key: Tuple[str, bytes], replica) -> None:
+        """Background compile of the pair's channel; traffic keeps flowing
+        on the slow path until the graph is ready."""
+        deployment = key[0]
+        try:
+            from ray_tpu.cgraph import actor_in_compiled_graph
+            from ray_tpu.dag import InputNode
+
+            if actor_in_compiled_graph(replica):
+                # a user's CompiledDeploymentHandle owns this replica's loop
+                raise RuntimeError("replica already hosts a compiled graph")
+            with InputNode() as inp:
+                node = replica.handle_request_fastpath.bind(inp)
+            dag = node.experimental_compile(
+                max_in_flight=max(1, _config.serve_fastpath_max_in_flight)
+            )
+        except Exception as e:  # noqa: BLE001 - replica died/pinned/raced
+            logger.info("serve fastpath: warm failed for %r (%s)",
+                        deployment, e)
+            with self._lock:
+                p = self._pairs.get(key)
+                if p is not None:
+                    p.state = "demoted"
+                    p.demoted_until = (
+                        time.monotonic() + _config.serve_fastpath_cooldown_s
+                    )
+            return
+        q: _queue.Queue = _queue.Queue()
+        t = threading.Thread(
+            target=self._drain, args=(key, q, dag),
+            name=f"serve-fastpath-drain-{deployment}", daemon=True,
+        )
+        stale = False
+        with self._lock:
+            p = self._pairs.get(key)
+            if p is None or p.state != "warming" or self._closed:
+                stale = True  # retained-away or closed while compiling
+            else:
+                p.dag = dag
+                p.queue = q
+                p.drainer = t
+                p.state = "ready"
+        if stale:
+            try:
+                dag.teardown(timeout=2.0)
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        t.start()
+        self._update_gauge(deployment)
+        logger.info("serve fastpath: channel ready for %r", deployment)
+
+    # ----------------------------------------------------------- dispatch
+    def try_dispatch(self, deployment: str, rkey: bytes, replica, args,
+                     kwargs, deadline: Optional[float],
+                     trace_id: Optional[str], fulfill) -> bool:
+        """Dispatch one admitted unary request over the pair's channel.
+        Returns False (caller uses the slow path) when the pair isn't
+        ready or the channel is full; never blocks. ``fulfill`` is the
+        caller's (already latency-wrapped) deferred-ref fulfiller."""
+        from ray_tpu.cgraph.channel import ChannelTimeoutError
+
+        key = (deployment, rkey)
+        with self._lock:
+            p = self._pairs.get(key)
+            if p is None or p.state != "ready":
+                return False
+            dag, q = p.dag, p.queue
+        # execute OUTSIDE the pool lock (it takes the dag's exec lock and
+        # may probe the control plane); a demote racing us puts _STOP ahead
+        # of this item, and the drainer's residual sweep still resolves it
+        try:
+            ref = dag.execute((deadline, trace_id, args, kwargs), timeout=0)
+        except ChannelTimeoutError:
+            return False  # channel full: overflow rides the slow path
+        except Exception as e:  # noqa: BLE001 - dead loop/severed/torn
+            self.demote(key, f"dispatch failed: {e!r}")
+            self._count_fallback(deployment)  # this request degrades
+            return False
+        item = _Item(ref, fulfill, deployment, rkey, replica, args,
+                     kwargs, deadline, trace_id)
+        with self._lock:
+            p = self._pairs.get(key)
+            live = p is not None and p.state == "ready" and p.queue is q
+            if live:
+                # enqueue-while-ready is atomic with demote's _STOP, so
+                # the drainer provably sees every enqueued item
+                q.put(item)
+        if not live:
+            # the pair demoted between execute and enqueue: the submitted
+            # seq dies with the graph — degrade THIS request to the slow
+            # path through the normal budgeted failover
+            self._router.fastpath_failover(item, RuntimeError(
+                "compiled graph fast-path channel demoted mid-dispatch"
+            ))
+            return True
+        sm = self._metrics()
+        if sm is not None:
+            sm.fastpath_requests.inc(1.0, {"deployment": deployment})
+        return True
+
+    # ------------------------------------------------------------ drainer
+    def _drain(self, key: Tuple[str, bytes], q: "_queue.Queue", dag) -> None:
+        """Per-pair drainer: resolves each in-flight fast-path request and
+        fulfills its deferred ref — success, user error, or (on a severed
+        channel / dead replica) the budgeted slow-path failover. Runs until
+        the pair demotes and its queue drains, then tears the graph down."""
+        from ray_tpu import exceptions as exc
+        from ray_tpu.cgraph.channel import (
+            ChannelClosedError,
+            ChannelSeveredError,
+            ChannelTimeoutError,
+        )
+        from ray_tpu.cgraph.compiled_dag import CompiledGraphError
+
+        router = self._router
+
+        def resolve(item: "_Item") -> None:
+            timeout = (
+                max(0.05, item.deadline - time.time())
+                if item.deadline is not None
+                else router.timeout_for(item.deployment)
+            )
+            try:
+                value = item.ref.get(timeout=timeout)
+            except (ChannelTimeoutError, exc.GetTimeoutError):
+                # slow/wedged pinned replica: same breaker semantics as a
+                # routed header timeout — vote failure, surface typed
+                router.fastpath_complete(item, ok=False)
+                item.fulfill(error=exc.GetTimeoutError(
+                    f"fast-path request to {item.deployment!r} timed out "
+                    f"after {timeout:.1f}s"
+                ))
+                return
+            except (exc.ActorDiedError, exc.ActorUnavailableError,
+                    ChannelSeveredError, ChannelClosedError,
+                    CompiledGraphError) as e:
+                # graph-infrastructure failure (typed — CompiledGraphError
+                # covers the dag's own loop-died/torn-down/misaligned
+                # errors, never a forwarded user exception): demote the
+                # pair and degrade this request to the slow path
+                self.demote(key, repr(e))
+                router.fastpath_failover(item, e)
+                return
+            except BaseException as e:  # noqa: BLE001 - user exception
+                # the replica worked; the user callable raised (includes
+                # the replica-side typed deadline shed and any user
+                # RuntimeError). ok=True — user errors NEVER vote the
+                # breaker down, exactly like the routed path.
+                router.fastpath_complete(item, ok=True)
+                item.fulfill(error=e)
+                return
+            router.fastpath_complete(item, ok=True)
+            item.fulfill(value=value)
+
+        while True:
+            item: Any = q.get()
+            if item is _STOP:
+                break
+            resolve(item)
+        # residual sweep: dispatches that raced the demote sit behind the
+        # sentinel — resolve them (completed seqs salvage from the output
+        # rings, lost ones fail over) before the teardown
+        while True:
+            try:
+                resolve(q.get_nowait())
+            except _queue.Empty:
+                break
+        if dag is not None:
+            try:
+                dag.teardown(timeout=5.0)
+            except Exception:  # noqa: BLE001 - loops already gone
+                pass
+
+    # ----------------------------------------------------------- demotion
+    def demote(self, key: Tuple[str, bytes], reason: str) -> None:
+        """Demote a pair to the slow path for the cooldown. In-flight items
+        keep draining (completed seqs are salvaged from the output ring;
+        lost ones fail over) and the drainer tears the graph down after."""
+        with self._lock:
+            p = self._pairs.get(key)
+            if p is None or p.state != "ready":
+                return
+            self._demote_locked(key, p, reason)
+        # NOT counted as a fallback here: serve_fastpath_fallbacks_total is
+        # per REQUEST degraded (the dispatch-failure branch and
+        # fastpath_failover count those); a demote with nothing in flight
+        # degrades zero requests
+        self._update_gauge(key[0])
+
+    def _demote_locked(self, key, p: "_Pair", reason: str) -> None:
+        p.state = "demoted"
+        p.demoted_until = time.monotonic() + _config.serve_fastpath_cooldown_s
+        p.successes = 0
+        if p.queue is not None:
+            p.queue.put(_STOP)
+        p.dag = None
+        p.queue = None
+        p.drainer = None
+        logger.warning(
+            "serve fastpath: demoted a replica channel of %r to the slow "
+            "path (%s)", key[0], reason,
+        )  # gauge refresh happens in the callers, outside self._lock
+
+    def retain(self, live_keys) -> None:
+        """Routing refresh: demote pairs whose replica left the fleet
+        (death, scale-down, redeploy). Called under the router lock — only
+        pair state flips here, the drainer does the teardown."""
+        demoted = []
+        with self._lock:
+            for key, p in list(self._pairs.items()):
+                if key not in live_keys:
+                    if p.state == "ready":
+                        self._demote_locked(key, p, "replica left routing")
+                        demoted.append(key[0])
+                    else:
+                        self._pairs.pop(key, None)
+        for dep in demoted:
+            self._update_gauge(dep)
+
+    def ready_deployments(self) -> Dict[str, int]:
+        """deployment -> ready channel count (introspection/tests)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (dep, _), p in self._pairs.items():
+                if p.state == "ready":
+                    out[dep] = out.get(dep, 0) + 1
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for key, p in list(self._pairs.items()):
+                if p.state == "ready":
+                    self._demote_locked(key, p, "router closed")
+            self._pairs.clear()
+
+    # ------------------------------------------------------------ metrics
+    def _metrics(self):
+        from ray_tpu.serve.handle import serve_metrics
+
+        return serve_metrics()
+
+    def _count_fallback(self, deployment: str) -> None:
+        sm = self._metrics()
+        if sm is not None:
+            sm.fastpath_fallbacks.inc(1.0, {"deployment": deployment})
+
+    def _update_gauge(self, deployment: str) -> None:
+        sm = self._metrics()
+        if sm is None:
+            return
+        with self._lock:
+            n = sum(
+                1 for (dep, _), p in self._pairs.items()
+                if dep == deployment and p.state == "ready"
+            )
+        sm.fastpath_channels.set(n, {"deployment": deployment})

@@ -100,6 +100,21 @@ class _ServeMetrics:
             "replicas currently ejected by an open circuit breaker",
             tag_keys=dep,
         )
+        # ---- fast-path dispatch (compiled/transport plane) ----
+        self.fastpath_requests = m.Counter(
+            "serve_fastpath_requests_total",
+            "requests dispatched over compiled fast-path channels",
+            tag_keys=dep,
+        )
+        self.fastpath_fallbacks = m.Counter(
+            "serve_fastpath_fallbacks_total",
+            "fast-path requests that degraded to the router slow path "
+            "(severed channel, replica death, demotion)", tag_keys=dep,
+        )
+        self.fastpath_channels = m.Gauge(
+            "serve_fastpath_channels",
+            "warmed (deployment, replica) compiled channels", tag_keys=dep,
+        )
 
 
 _serve_metrics_inst: Optional[_ServeMetrics] = None
@@ -167,6 +182,39 @@ class Router:
         self._breakers: Dict[tuple, _Breaker] = {}
         self._budgets: Dict[str, Any] = {}
         self._backoff = None
+        # fast-path dispatch: router-managed pool of compiled channels for
+        # warmed (deployment, replica) pairs (serve/fast_path.py)
+        from ray_tpu.serve.fast_path import FastPathPool
+
+        self._fastpath = FastPathPool(self)
+        # async admission (remote_async): asyncio waiters woken alongside
+        # the capacity condition variable, so a coroutine queues on the
+        # router's admission wait without holding a thread
+        self._async_waiters: List[Any] = []
+        # proxy unary-history: deployment -> consecutive non-streaming
+        # responses (the proxy switches to unary fast-path dispatch once a
+        # deployment has proven steadily unary)
+        self._unary_streak: Dict[str, int] = {}
+
+    def _notify_capacity(self) -> None:
+        """Wake everyone parked on admission capacity: the condition
+        variable (threaded callers) AND any asyncio waiters (remote_async).
+        Must be called with ``self._lock`` held (it IS the cv's lock)."""
+        self._capacity_cv.notify_all()
+        if self._async_waiters:
+            waiters, self._async_waiters = self._async_waiters, []
+            for loop, fut in waiters:
+                try:
+                    loop.call_soon_threadsafe(
+                        lambda f=fut: None if f.done() else f.set_result(None)
+                    )
+                except RuntimeError:  # loop already closed
+                    pass
+
+    def close(self) -> None:
+        """Release router-held resources (the fast-path channel pool);
+        serve.shutdown() calls this before killing the controller."""
+        self._fastpath.close()
 
     # ------------------------------------------------ retry budget + backoff
     def _budget(self, deployment: str):
@@ -212,15 +260,21 @@ class Router:
         return err
 
     # ------------------------------------------------------ deadline minting
+    @staticmethod
+    def _combine_deadline(timeout: float, active: Optional[float]) -> float:
+        """now + timeout, tightened by an already-active deadline (a nested
+        deployment call never outlives its root request's budget). The one
+        place the min/None semantics live — the sync and async dispatch
+        paths both mint through here."""
+        deadline = time.time() + timeout
+        return min(deadline, active) if active is not None else deadline
+
     def request_deadline(self, deployment: str,
                          timeout: Optional[float] = None) -> float:
         """Absolute deadline for one request: now + the effective timeout,
-        tightened by any deadline already active on this thread (a nested
-        deployment call never outlives its root request's budget)."""
+        tightened by any deadline active on this thread."""
         timeout = timeout if timeout is not None else self.timeout_for(deployment)
-        deadline = time.time() + timeout
-        active = tracing.current_deadline()
-        return min(deadline, active) if active is not None else deadline
+        return self._combine_deadline(timeout, tracing.current_deadline())
 
     def _shed_expired(self, deployment: str, deadline: Optional[float],
                       sm, tags, t0) -> None:
@@ -391,7 +445,9 @@ class Router:
             pruned = [k for k in self._breakers if k not in live_keys]
             for bk in pruned:
                 self._breakers.pop(bk, None)
-            self._capacity_cv.notify_all()  # fresh replicas: wake waiters
+            self._notify_capacity()  # fresh replicas: wake waiters
+        # fast-path channels of replaced/dead replicas demote with them
+        self._fastpath.retain(live_keys)
         for dep in {d for d, _ in pruned}:
             self._update_circuit_gauge(dep)  # a popped OPEN breaker un-gauges
 
@@ -426,9 +482,13 @@ class Router:
         Overload protection: a deadline minted here (request_timeout_s /
         handle timeout, tightened by any active deadline) rides the task
         context into the replica and every nested call; an expired or
-        over-queue request sheds typed before any replica sees it."""
-        from ray_tpu.api import _global_worker
+        over-queue request sheds typed before any replica sees it.
 
+        Fast path: once a (deployment, replica) pair is warmed
+        (serve/fast_path.py), the dispatch after admission goes over the
+        pair's compiled channel instead of a task submission — same
+        metrics, breaker votes and failover semantics, a fraction of the
+        per-request cost."""
         # tracing: one trace id per request (kept when the caller — e.g. an
         # upstream replica in a composed app — already runs inside one), so
         # the handle span, the replica's task events, and any nested
@@ -449,28 +509,111 @@ class Router:
             self._budget(deployment).note_request()
             self._shed_expired(deployment, deadline, sm, tags, t0)
             try:
-                with tracing.deadline_context(deadline):
-                    ref, replica = self.assign_request_with_replica(
-                        deployment, *args, _deadline=deadline, **kwargs
-                    )
+                replica, rkey = self._pick_replica(
+                    deployment, deadline=deadline
+                )
             except BaseException:
                 self._observe_error(sm, tags, t0)
                 raise
             if sm is not None:
                 sm.queue.observe((time.perf_counter() - t0) * 1000, tags)
-            deferred = (
-                _global_worker().backend.create_deferred()
-                if _config.serve_request_retries > 0 else None
+            return self._dispatch_picked(
+                deployment, replica, rkey, args, kwargs, deadline,
+                trace_id, sm, t0,
             )
-            if deferred is None:  # retries disabled / no deferred-ref support
-                self._observe_completion(sm, deployment, t0, ref)
-                return ref
+
+    def _dispatch_picked(self, deployment: str, replica, rkey: bytes, args,
+                         kwargs, deadline: Optional[float],
+                         trace_id: Optional[str], sm, t0: float):
+        """Dispatch one ADMITTED request (inflight slot already taken by
+        _pick_replica/_pick_candidate): over the pair's compiled fast-path
+        channel when warmed, else the routed slow path. Returns the ref the
+        caller holds; all completion accounting (e2e latency, error
+        counter, inflight decrement, breaker vote) fires exactly once per
+        request on either path."""
+        from ray_tpu.api import _global_worker
+
+        deferred = (
+            _global_worker().backend.create_deferred()
+            if _config.serve_request_retries > 0 else None
+        )
+        if deferred is not None:
             out_ref, fulfill = deferred
             fulfill = self._timed_fulfill(sm, deployment, t0, fulfill)
-            self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
-                               attempt=0, trace_id=trace_id,
-                               deadline=deadline)
-            return out_ref
+            if self._fastpath.try_dispatch(
+                deployment, rkey, replica, args, kwargs, deadline,
+                trace_id, fulfill,
+            ):
+                return out_ref
+        # slow path: per-request task submission to the picked replica
+        try:
+            with tracing.deadline_context(deadline):
+                ref = replica.handle_request.remote(*args, **kwargs)
+        except BaseException:
+            self._dec_inflight(deployment, rkey)
+            self._observe_error(sm, {"deployment": deployment}, t0)
+            raise
+        self._track_completion(deployment, rkey, replica, ref)
+        if deferred is None:  # retries disabled / no deferred-ref support
+            self._observe_completion(sm, deployment, t0, ref)
+            return ref
+        self._arm_failover(deployment, ref, replica, args, kwargs, fulfill,
+                           attempt=0, trace_id=trace_id,
+                           deadline=deadline)
+        return out_ref
+
+    # -------------------------------------------- fast-path completion plane
+    def fastpath_complete(self, item, ok: bool) -> None:
+        """One fast-path request settled (value, user error, or timeout):
+        release its admission slot and feed the replica's breaker — the
+        same accounting _track_completion does for routed dispatches."""
+        self._dec_inflight(item.deployment, item.rkey)
+        self.record_replica_outcome(
+            item.deployment, item.rkey, ok,
+            (time.monotonic() - item.dispatched_at) * 1000,
+            dispatched_at=item.dispatched_at,
+        )
+
+    def fastpath_failover(self, item, error: BaseException) -> None:
+        """A fast-path request lost its channel (severed transport, dead
+        replica): degrade to the router slow path with the SAME typed retry
+        semantics as a routed replica death — breaker vote, eviction only
+        when the control plane agrees the replica is gone, one budgeted
+        retry re-dispatched through assign_request_with_replica."""
+        deployment, replica = item.deployment, item.replica
+        self._dec_inflight(deployment, item.rkey)
+        self.record_replica_outcome(
+            deployment, item.rkey, False, dispatched_at=item.dispatched_at
+        )
+        # only report the replica dead when the control plane agrees: a
+        # severed cross-node channel can strand a LIVE replica, and the
+        # pair demotion (fresh slow-path dispatches) is recovery enough
+        from ray_tpu.api import _global_worker
+
+        try:
+            state = _global_worker().backend.actor_state(replica._actor_id)
+        except Exception:  # noqa: BLE001 - control-plane blip
+            state = "UNKNOWN"
+        if state in ("DEAD", "RESTARTING"):
+            self._on_replica_failure(deployment, replica)
+        sm = serve_metrics()
+        if sm is not None:
+            sm.fastpath_fallbacks.inc(1.0, {"deployment": deployment})
+        if item.deadline is not None and time.time() >= item.deadline:
+            if sm is not None:
+                sm.deadline_expired.inc(1.0, {"deployment": deployment})
+            item.fulfill(error=exc.DeadlineExceededError(
+                f"request to {deployment!r} not retried: deadline "
+                "expired during the failed fast-path attempt"
+            ))
+            return
+        if not self.spend_retry_token(deployment):
+            item.fulfill(error=self._budget_error(deployment, error))
+            return
+        self._enqueue_retry(
+            deployment, item.args, item.kwargs, item.fulfill, 1,
+            item.trace_id, item.deadline,
+        )
 
     # --------------------------------------------------------- SLO metrics
     @staticmethod
@@ -624,11 +767,12 @@ class Router:
                 if counts is not None:
                     counts.pop(key, None)  # other replicas' counts survive
                 self._breakers.pop((deployment, key), None)
-                self._capacity_cv.notify_all()  # waiters re-read the fleet
+                self._notify_capacity()  # waiters re-read the fleet
                 logger.warning(
                     "serve: evicted dead replica of %r (%d left)",
                     deployment, len(kept),
                 )
+        self._fastpath.demote((deployment, key), "replica reported dead")
         self._update_circuit_gauge(deployment)  # popped breaker may be open
         sm = serve_metrics()
         if sm is not None:
@@ -722,6 +866,87 @@ class Router:
             time.sleep(0.1)
             self._refresh(force=True)
 
+    def _pick_candidate(self, deployment: str, max_ongoing: int, sm, tags,
+                        t_start: float):
+        """One admission attempt (called under ``self._lock``): breaker
+        filtering + power-of-two-choices over free capacity. Returns
+        (replica, rkey, total inflight) when a dispatch slot was taken,
+        None when the caller should wait for capacity; raises the typed
+        sheds (every-breaker-open, no-replicas timeout)."""
+        counts = self._inflight.setdefault(deployment, {})
+        replicas = list(self._replicas.get(deployment) or ())
+        keys = [r._actor_id.binary() for r in replicas]
+        now = time.monotonic()
+        if replicas:
+            allowed = [
+                i for i, k in enumerate(keys)
+                if (brk := self._breakers.get((deployment, k)))
+                is None or self._breaker_admits(brk, now)
+            ]
+            if not allowed and all(
+                (b2 := self._breakers.get((deployment, k)))
+                is not None and b2.state == "open"
+                for k in keys
+            ):
+                if sm is not None:
+                    sm.shed.inc(1.0, tags)
+                raise exc.BackPressureError(
+                    f"every replica of {deployment!r} is "
+                    "circuit-open (cooling down after "
+                    "consecutive failures)"
+                )
+            free = [
+                i for i in allowed
+                if max_ongoing <= 0
+                or counts.get(keys[i], 0) < max_ongoing
+            ]
+            if free:
+                if len(free) == 1:
+                    idx = free[0]
+                else:
+                    a, b = random.sample(free, 2)
+                    idx = (
+                        a if counts.get(keys[a], 0)
+                        <= counts.get(keys[b], 0) else b
+                    )
+                rkey = keys[idx]
+                br = self._breakers.get((deployment, rkey))
+                if br is not None and br.state == "half_open":
+                    br.probe_inflight = True  # THE probe
+                counts[rkey] = counts.get(rkey, 0) + 1
+                return replicas[idx], rkey, sum(counts.values())
+        if not replicas and time.monotonic() - t_start > 30.0:
+            raise RuntimeError(
+                f"no replicas for deployment {deployment!r}"
+            )
+        return None
+
+    def _admission_queue_enter(self, deployment: str, max_ongoing: int,
+                               max_queued: int, sm, tags) -> None:
+        """Join the router-side admission queue (under ``self._lock``);
+        sheds typed BackPressureError when the queue is at its bound."""
+        counts = self._inflight.setdefault(deployment, {})
+        if max_ongoing > 0 \
+                and self._queued.get(deployment, 0) >= max_queued:
+            if sm is not None:
+                sm.shed.inc(1.0, tags)
+            raise exc.BackPressureError(
+                f"deployment {deployment!r} over capacity: "
+                f"{max_queued} requests already queued "
+                f"(max_queued_requests) behind "
+                f"{sum(counts.values())} in flight"
+            )
+        self._queued[deployment] = self._queued.get(deployment, 0) + 1
+
+    def _shed_queued_deadline(self, deployment: str, sm, tags):
+        if sm is not None:
+            sm.deadline_expired.inc(1.0, tags)
+        return exc.DeadlineExceededError(
+            f"request to {deployment!r} shed: deadline "
+            "expired while queued at the router "
+            "(never dispatched to a replica)"
+        )
+
     def _pick_replica(self, deployment: str,
                       deadline: Optional[float] = None):
         """Admission control + circuit breaking + power-of-two-choices.
@@ -743,79 +968,26 @@ class Router:
         tags = {"deployment": deployment}
         t_start = time.monotonic()
         with self._capacity_cv:
-            counts = self._inflight.setdefault(deployment, {})
-            if max_ongoing > 0 \
-                    and self._queued.get(deployment, 0) >= max_queued:
-                if sm is not None:
-                    sm.shed.inc(1.0, tags)
-                raise exc.BackPressureError(
-                    f"deployment {deployment!r} over capacity: "
-                    f"{max_queued} requests already queued "
-                    f"(max_queued_requests) behind "
-                    f"{sum(counts.values())} in flight"
-                )
-            self._queued[deployment] = self._queued.get(deployment, 0) + 1
+            self._admission_queue_enter(
+                deployment, max_ongoing, max_queued, sm, tags
+            )
             try:
                 while True:
                     # re-read replicas each pass: evictions/refreshes while
                     # we waited must not dispatch to a dead replica
-                    replicas = list(self._replicas.get(deployment) or ())
-                    keys = [r._actor_id.binary() for r in replicas]
-                    now = time.monotonic()
-                    if replicas:
-                        allowed = [
-                            i for i, k in enumerate(keys)
-                            if (brk := self._breakers.get((deployment, k)))
-                            is None or self._breaker_admits(brk, now)
-                        ]
-                        if not allowed and all(
-                            (b2 := self._breakers.get((deployment, k)))
-                            is not None and b2.state == "open"
-                            for k in keys
-                        ):
-                            if sm is not None:
-                                sm.shed.inc(1.0, tags)
-                            raise exc.BackPressureError(
-                                f"every replica of {deployment!r} is "
-                                "circuit-open (cooling down after "
-                                "consecutive failures)"
-                            )
-                        free = [
-                            i for i in allowed
-                            if max_ongoing <= 0
-                            or counts.get(keys[i], 0) < max_ongoing
-                        ]
-                        if free:
-                            if len(free) == 1:
-                                idx = free[0]
-                            else:
-                                a, b = random.sample(free, 2)
-                                idx = (
-                                    a if counts.get(keys[a], 0)
-                                    <= counts.get(keys[b], 0) else b
-                                )
-                            rkey = keys[idx]
-                            br = self._breakers.get((deployment, rkey))
-                            if br is not None and br.state == "half_open":
-                                br.probe_inflight = True  # THE probe
-                            counts[rkey] = counts.get(rkey, 0) + 1
-                            total = sum(counts.values())
-                            break
-                    if not replicas and time.monotonic() - t_start > 30.0:
-                        raise RuntimeError(
-                            f"no replicas for deployment {deployment!r}"
-                        )
+                    got = self._pick_candidate(
+                        deployment, max_ongoing, sm, tags, t_start
+                    )
+                    if got is not None:
+                        replica, rkey, total = got
+                        break
                     # no capacity (or a half-open cooldown pending): wait
                     # for a completion/refresh, bounded by the deadline
                     if deadline is not None:
                         remaining = deadline - time.time()
                         if remaining <= 0:
-                            if sm is not None:
-                                sm.deadline_expired.inc(1.0, tags)
-                            raise exc.DeadlineExceededError(
-                                f"request to {deployment!r} shed: deadline "
-                                "expired while queued at the router "
-                                "(never dispatched to a replica)"
+                            raise self._shed_queued_deadline(
+                                deployment, sm, tags
                             )
                         self._capacity_cv.wait(min(0.05, remaining))
                     else:
@@ -823,7 +995,116 @@ class Router:
             finally:
                 self._queued[deployment] -= 1
         self._set_inflight_gauge(deployment, total)
-        return replicas[idx], rkey
+        return replica, rkey
+
+    async def _pick_replica_async(self, deployment: str,
+                                  deadline: Optional[float] = None):
+        """Async twin of _pick_replica: identical admission semantics
+        (queue bound, breaker ejection, deadline shed, p2c), but the
+        capacity wait parks an asyncio future woken by _notify_capacity —
+        the calling thread (the caller's event loop) is never blocked.
+        Table refreshes run in the default executor (short, rate-limited)."""
+        import asyncio
+        import functools
+
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, functools.partial(
+            self.wait_for_replicas, deployment, 30.0, deadline
+        ))
+        max_ongoing, max_queued = await loop.run_in_executor(
+            None,
+            lambda: (self.max_ongoing_for(deployment),
+                     self.max_queued_for(deployment)),
+        )
+        sm = serve_metrics()
+        tags = {"deployment": deployment}
+        t_start = time.monotonic()
+        with self._capacity_cv:
+            self._admission_queue_enter(
+                deployment, max_ongoing, max_queued, sm, tags
+            )
+        try:
+            while True:
+                with self._capacity_cv:
+                    got = self._pick_candidate(
+                        deployment, max_ongoing, sm, tags, t_start
+                    )
+                if got is not None:
+                    replica, rkey, total = got
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise self._shed_queued_deadline(
+                            deployment, sm, tags
+                        )
+                    wait_s = min(0.05, remaining)
+                else:
+                    wait_s = 0.05
+                fut = loop.create_future()
+                with self._capacity_cv:
+                    self._async_waiters.append((loop, fut))
+                try:
+                    await asyncio.wait_for(fut, wait_s)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            with self._capacity_cv:
+                self._queued[deployment] -= 1
+        self._set_inflight_gauge(deployment, total)
+        return replica, rkey
+
+    async def assign_request_async(self, deployment: str, *args,
+                                   _timeout_s: Optional[float] = None,
+                                   **kwargs):
+        """Async-admission dispatch (DeploymentHandle.remote_async): the
+        same arrival accounting, deadline minting, shed semantics and
+        fast/slow dispatch as assign_request, but an admission wait QUEUES
+        this coroutine instead of blocking a thread. Returns the ObjectRef.
+
+        Tracing note: thread-local contexts don't survive awaits, so the
+        trace/deadline context wraps only the final (non-awaiting)
+        dispatch — nested calls made BY the replica still inherit both."""
+        import asyncio
+        import functools
+
+        loop = asyncio.get_running_loop()
+        sm = serve_metrics()
+        tags = {"deployment": deployment}
+        t0 = time.perf_counter()
+        if sm is not None:
+            sm.requests.inc(1.0, tags)
+        trace_id = tracing.current_trace_id() or tracing.new_trace_id()
+        # the active deadline is thread-local: read it on the loop thread
+        # BEFORE any await, then mint through the shared helper
+        active = tracing.current_deadline()
+        timeout = (
+            _timeout_s if _timeout_s is not None
+            else await loop.run_in_executor(
+                None, functools.partial(self.timeout_for, deployment)
+            )
+        )
+        deadline = self._combine_deadline(timeout, active)
+        self._budget(deployment).note_request()
+        self._shed_expired(deployment, deadline, sm, tags, t0)
+        try:
+            replica, rkey = await self._pick_replica_async(
+                deployment, deadline=deadline
+            )
+        except BaseException:
+            self._observe_error(sm, tags, t0)
+            raise
+        if sm is not None:
+            sm.queue.observe((time.perf_counter() - t0) * 1000, tags)
+        with tracing.trace_context(trace_id):
+            tracing.get_buffer().record_profile(
+                "serve.request", component="serve",
+                args={"deployment": deployment},
+            )
+            return self._dispatch_picked(
+                deployment, replica, rkey, args, kwargs, deadline,
+                trace_id, sm, t0,
+            )
 
     def _set_inflight_gauge(self, deployment: str, total: int) -> None:
         sm = serve_metrics()
@@ -833,14 +1114,15 @@ class Router:
     def assign_request_with_replica(self, deployment: str, *args,
                                     _deadline: Optional[float] = None,
                                     **kwargs):
-        """Pick a replica (admission + breaker + p2c) and dispatch; returns
-        (ObjectRef, replica handle) — legacy-polling streaming keeps pulling
-        chunks from the SAME replica. ``_deadline`` bounds the replica wait
-        and rides the submission's task context into the replica."""
+        """Pick a replica (admission + breaker + p2c) and dispatch on the
+        SLOW path; returns (ObjectRef, replica handle) — legacy-polling
+        streaming and failover retries keep pulling from the SAME replica.
+        ``_deadline`` bounds the replica wait and rides the submission's
+        task context into the replica."""
         replica, rkey = self._pick_replica(deployment, deadline=_deadline)
         with tracing.deadline_context(_deadline):
             ref = replica.handle_request.remote(*args, **kwargs)
-        self._track_completion(deployment, rkey, ref)
+        self._track_completion(deployment, rkey, replica, ref)
         return ref, replica
 
     def stream_request(self, deployment: str, args=(), kwargs=None,
@@ -913,6 +1195,11 @@ class Router:
                         sm.e2e.observe(
                             (time.perf_counter() - t0) * 1000, tags
                         )
+                    self.note_response_kind(
+                        deployment,
+                        bool(header.get("streaming"))
+                        if isinstance(header, dict) else False,
+                    )
                     return header, gen, replica
                 except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
                     self._dec_inflight(deployment, rkey)
@@ -943,16 +1230,64 @@ class Router:
                     self._observe_error(sm, tags, t0)
                     raise
 
+    # ---------------------------------------------------- proxy unary plane
+    def note_response_kind(self, deployment: str, streaming: bool) -> None:
+        """Response-shape history: the proxy switches a deployment to
+        unary-optimistic dispatch (fast-path capable) once it has answered
+        enough consecutive requests without streaming."""
+        if streaming:
+            self._unary_streak[deployment] = 0
+        else:
+            self._unary_streak[deployment] = \
+                self._unary_streak.get(deployment, 0) + 1
+
+    def prefers_unary(self, deployment: str) -> bool:
+        return self._unary_streak.get(deployment, 0) >= 8
+
+    def resolve_stream_marker(self, deployment: str, sid: str,
+                              timeout: float):
+        """A unary-optimistic dispatch surfaced a legacy stream marker (a
+        mixed unary/streaming deployment): locate the replica holding the
+        sid and yield its chunks over the polling compat protocol. The
+        history reset (note_response_kind) already routed the NEXT request
+        back through the push-based streaming dispatch."""
+        import ray_tpu
+
+        with self._lock:
+            replicas = list(self._replicas.get(deployment) or ())
+        first = None
+        owner = None
+        for r in replicas:
+            try:
+                first = ray_tpu.get(r.next_chunk.remote(sid), timeout=timeout)
+                owner = r
+                break
+            except Exception:  # noqa: BLE001 - unknown sid on this replica
+                continue
+        if owner is None:
+            raise RuntimeError(
+                f"stream {sid} of {deployment!r} not found on any replica"
+            )
+
+        def chunks():
+            c = first
+            while not c.get("done"):
+                yield c["value"]
+                c = ray_tpu.get(owner.next_chunk.remote(sid), timeout=timeout)
+
+        return chunks()
+
     def _dec_inflight(self, deployment: str, rkey: bytes) -> None:
         with self._lock:
             counts = self._inflight.get(deployment)
             if counts and counts.get(rkey, 0) > 0:
                 counts[rkey] -= 1
             total = sum(counts.values()) if counts else 0
-            self._capacity_cv.notify_all()  # capacity freed: admit a waiter
+            self._notify_capacity()  # capacity freed: admit a waiter
         self._set_inflight_gauge(deployment, total)
 
-    def _track_completion(self, deployment: str, rkey: bytes, ref) -> None:
+    def _track_completion(self, deployment: str, rkey: bytes, replica,
+                          ref) -> None:
         t0 = time.monotonic()  # dispatch time (comparable to _Breaker clocks)
 
         def done(fut):
@@ -961,7 +1296,7 @@ class Router:
                 if counts and counts.get(rkey, 0) > 0:
                     counts[rkey] -= 1
                 total = sum(counts.values()) if counts else 0
-                self._capacity_cv.notify_all()  # capacity freed
+                self._notify_capacity()  # capacity freed
             self._set_inflight_gauge(deployment, total)
             if fut is None:
                 return
@@ -975,10 +1310,16 @@ class Router:
                 ok = False
             except BaseException:  # noqa: BLE001 - user error: replica works
                 pass
+            latency_ms = (time.monotonic() - t0) * 1000
             self.record_replica_outcome(
-                deployment, rkey, ok, (time.monotonic() - t0) * 1000,
-                dispatched_at=t0,
+                deployment, rkey, ok, latency_ms, dispatched_at=t0,
             )
+            if ok:
+                # warmth signal for the fast path: enough successful,
+                # fast dispatches to one pair compile its channel
+                self._fastpath.note_success(
+                    deployment, rkey, replica, latency_ms
+                )
 
         try:
             ref.future().add_done_callback(done)
@@ -1024,6 +1365,18 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         return self._router.assign_request(
+            self.deployment_name, *args, _timeout_s=self._timeout_s, **kwargs
+        )
+
+    async def remote_async(self, *args, **kwargs):
+        """Async-admission twin of remote(): awaiting it queues on the
+        router's admission wait (max_ongoing/max_queued) WITHOUT blocking
+        the calling thread — an asyncio server can hold thousands of
+        queued requests on one loop. Resolves to the same ObjectRef
+        remote() returns (``ray_tpu.get`` it, or hand it on). Shedding,
+        deadlines, breakers, metrics and the compiled fast path behave
+        exactly like remote()."""
+        return await self._router.assign_request_async(
             self.deployment_name, *args, _timeout_s=self._timeout_s, **kwargs
         )
 

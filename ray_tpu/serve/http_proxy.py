@@ -197,6 +197,12 @@ class HTTPProxy:
                     timeout = min(timeout, max(0.0, float(client_t)))
                 except ValueError:
                     pass
+            if self._router.prefers_unary(name):
+                # steadily-unary deployment: dispatch through the router's
+                # unary plane, which rides the compiled fast path once the
+                # pair is warmed — the streaming entry point costs an extra
+                # header item per request and can never use the channel
+                return self._unary_dispatch(name, args, timeout)
             header, gen, _replica = self._router.stream_request(
                 name, args, timeout=timeout
             )
@@ -230,11 +236,37 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001 - surface as 500
             return "500 Internal Server Error", {"error": str(e)}, None
 
-    def _next_push_chunk(self, gen, timeout):
-        """Blocking pull of the next pushed item's value (executor thread);
-        returns _STREAM_END at the typed end-of-stream."""
+    def _unary_dispatch(self, name: str, args, timeout: float):
+        """Unary-optimistic dispatch (fast-path capable): one routed/
+        compiled request instead of the streaming entry point. A mixed
+        deployment that answers with a legacy stream marker anyway falls
+        back to the polling compat protocol for THIS response and resets
+        the deployment's unary history."""
         import ray_tpu
 
+        ref = self._router.assign_request(name, *args, _timeout_s=timeout)
+        result = ray_tpu.get(ref, timeout=timeout)
+        if isinstance(result, dict) and "__serve_stream__" in result:
+            self._router.note_response_kind(name, streaming=True)
+            gen = self._router.resolve_stream_marker(
+                name, result["__serve_stream__"], timeout
+            )
+            return "stream", (gen, timeout), None
+        self._router.note_response_kind(name, streaming=False)
+        return "200 OK", {"result": result}, None
+
+    def _next_push_chunk(self, gen, timeout):
+        """Blocking pull of the next pushed item's value (executor thread);
+        returns _STREAM_END at the typed end-of-stream. Accepts both the
+        push-generator interface (next_ref) and a plain iterator (the
+        stream-marker compat path)."""
+        import ray_tpu
+
+        if not hasattr(gen, "next_ref"):
+            try:
+                return next(gen)
+            except StopIteration:
+                return _STREAM_END
         try:
             ref = gen.next_ref(timeout)
         except StopIteration:

@@ -66,11 +66,23 @@ class _ReplicaMetrics:
             "(merges with the router-side series cluster-wide)",
             tag_keys=("deployment",),
         )
+        self.deadline_expired = m.Counter(
+            "serve_deadline_expired_total",
+            "fast-path requests shed at the replica on an expired deadline "
+            "(merges with the router-side series cluster-wide)",
+            tag_keys=("deployment",),
+        )
+        self.ongoing_streams = m.Gauge(
+            "serve_ongoing_streams",
+            "streaming responses currently open in this replica",
+            tag_keys=("deployment",),
+        )
 
 
 class ServeReplica:
     def __init__(self, func_or_class, init_args, init_kwargs,
-                 deployment_name: str = "", max_ongoing: int = 0):
+                 deployment_name: str = "", max_ongoing: int = 0,
+                 max_ongoing_streams: int = -1):
         init_args = tuple(_resolve_bound(a) for a in init_args)
         init_kwargs = {k: _resolve_bound(v) for k, v in init_kwargs.items()}
         if inspect.isclass(func_or_class):
@@ -82,6 +94,17 @@ class ServeReplica:
         # the actor's max_concurrency leaves +2 headroom threads so health
         # checks and this fast-reject never queue behind saturated work
         self._max_ongoing = max_ongoing
+        # cap on concurrently-OPEN streaming responses (0 = off; -1 = the
+        # config default). A stream stops debiting unary admission once its
+        # header is out (streams are long-lived by design), so without this
+        # cap stream fan-out could hold every replica thread and starve
+        # unary requests — the admission-debit gap this closes.
+        if max_ongoing_streams < 0:
+            from ray_tpu.core.config import _config
+
+            max_ongoing_streams = _config.serve_max_ongoing_streams
+        self._max_ongoing_streams = max_ongoing_streams
+        self._ongoing_streams = 0
         self._metrics: Any = None  # built lazily (config-gated)
         self._ongoing = 0
         self._total = 0
@@ -164,12 +187,15 @@ class ServeReplica:
         single result. A mid-chunk user exception surfaces on the exact item
         that raised (streaming-generator error semantics)."""
         self._admit()
+        self._admit_stream()
         self._ongoing += 1
+        self._ongoing_streams += 1
         self._total += 1
         m = self._m()
         t0 = time.perf_counter()
         if m is not None:
             m.ongoing.set(self._ongoing, m.tags)
+            m.ongoing_streams.set(self._ongoing_streams, m.tags)
         try:
             target = self._callable
             if not callable(target):
@@ -187,10 +213,32 @@ class ServeReplica:
                 yield {"streaming": False}
                 yield result
         finally:
+            # the finally runs when the stream completes, errors, or the
+            # consumer closes/abandons it — the stream-cap slot frees then
             self._ongoing -= 1
+            self._ongoing_streams -= 1
             if m is not None:
                 m.exec.observe((time.perf_counter() - t0) * 1000, m.tags)
                 m.ongoing.set(self._ongoing, m.tags)
+                m.ongoing_streams.set(self._ongoing_streams, m.tags)
+
+    def _admit_stream(self):
+        """Per-replica stream cap: a long-lived stream stops debiting unary
+        admission after its header, so concurrently-open streams get their
+        own typed bound (max_ongoing_streams) — fan-out cannot occupy every
+        replica thread and starve unary requests."""
+        if 0 < self._max_ongoing_streams <= self._ongoing_streams:
+            self._sheds += 1
+            m = self._m()
+            if m is not None:
+                m.shed.inc(1.0, m.tags)
+            from ray_tpu import exceptions as exc
+
+            raise exc.BackPressureError(
+                f"replica of {self._deployment_name!r} at "
+                f"max_ongoing_streams={self._max_ongoing_streams} open "
+                "streaming responses"
+            )
 
     def _reap_streams(self) -> None:
         now = time.monotonic()
@@ -246,6 +294,33 @@ class ServeReplica:
                 m.exec.observe((time.perf_counter() - t0) * 1000, m.tags)
                 m.ongoing.set(self._ongoing, m.tags)
 
+    def handle_request_fastpath(self, request) -> Any:
+        """Compiled fast-path entry point (serve/fast_path.py): the router
+        dispatches steady-state unary requests through a compiled channel
+        bound to this method instead of per-request task submission.
+
+        ``request`` is ``(deadline, trace_id, args, kwargs)``: the channel
+        carries no TaskSpec, so the deadline and trace id ride the payload
+        and re-enter the worker's task context here — nested deployment
+        calls inherit them exactly like on the routed path, and expired
+        requests shed typed BEFORE user code runs (PR-10 semantics)."""
+        from ray_tpu import exceptions as exc
+        from ray_tpu import tracing
+
+        deadline, trace_id, args, kwargs = request
+        if deadline is not None and time.time() >= deadline:
+            m = self._m()
+            if m is not None:
+                m.deadline_expired.inc(1.0, m.tags)
+            raise exc.DeadlineExceededError(
+                f"fast-path request to {self._deployment_name!r} shed at "
+                f"the replica: deadline exceeded by "
+                f"{time.time() - deadline:.3f}s"
+            )
+        with tracing.trace_context(trace_id or tracing.new_trace_id()):
+            with tracing.deadline_context(deadline):
+                return self.handle_request(*args, **kwargs)
+
     def next_chunk(self, sid: str) -> Dict[str, Any]:
         """Legacy polling path (compatibility fallback; new consumers use
         handle_request_streaming). An undrained sid that is gone — reaped,
@@ -289,6 +364,7 @@ class ServeReplica:
     def stats(self) -> dict:
         return {
             "ongoing": self._ongoing,
+            "ongoing_streams": self._ongoing_streams,
             "total": self._total,
             "legacy_polls": self._legacy_polls,
             "sheds": self._sheds,

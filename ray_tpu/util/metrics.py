@@ -25,11 +25,89 @@ name twice returns independent handles onto the same underlying series.
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _TagTuple = Tuple[Tuple[str, str], ...]
+
+# ----------------------------------------------------------------------- #
+# Quantile sketch (DDSketch-style): log-spaced buckets with a guaranteed
+# RELATIVE accuracy, so tail percentiles (p99/p999) come out within
+# ±_SKETCH_ALPHA of the true value instead of being interpolated across a
+# fixed exposition bucket that may span 2-4x. Every Histogram keeps one
+# sketch per tag combination alongside the Prometheus buckets; sketches are
+# mergeable (bucket-wise sums) and ride snapshots as an additive field, so
+# readers without sketch support (the dashboard JSON path) silently fall
+# back to the bucket interpolation.
+# ----------------------------------------------------------------------- #
+
+_SKETCH_ALPHA = 0.01  # 1% relative accuracy
+_SKETCH_GAMMA = (1.0 + _SKETCH_ALPHA) / (1.0 - _SKETCH_ALPHA)
+_SKETCH_INV_LOG_GAMMA = 1.0 / math.log(_SKETCH_GAMMA)
+# backstop on distinct sketch buckets per point (values spanning the full
+# float range at 1% accuracy stay well under this; a runaway series
+# collapses its lowest buckets instead of growing without bound)
+_SKETCH_MAX_BUCKETS = 2048
+
+
+def _sketch_index(value: float) -> int:
+    """Bucket i covers (gamma^(i-1), gamma^i]: every value in it is within
+    alpha (relative) of the bucket's representative value."""
+    return math.ceil(math.log(value) * _SKETCH_INV_LOG_GAMMA)
+
+
+def _sketch_value(index: int) -> float:
+    """Representative (midpoint) value of sketch bucket ``index``."""
+    return 2.0 * _SKETCH_GAMMA ** index / (_SKETCH_GAMMA + 1.0)
+
+
+def _sketch_observe(sk: dict, value: float) -> None:
+    """Record one observation into a per-point sketch ``{"z": zero_count,
+    "c": {index: count}}`` (values <= 0 land in "z")."""
+    if value <= 0:
+        sk["z"] += 1
+        return
+    counts = sk["c"]
+    idx = _sketch_index(value)
+    counts[idx] = counts.get(idx, 0) + 1
+    if len(counts) > _SKETCH_MAX_BUCKETS:
+        # collapse the lowest bucket into its neighbor (tail accuracy is
+        # what the sketch is for; the low end degrades gracefully)
+        lo = min(counts)
+        nxt = min(k for k in counts if k != lo)
+        counts[nxt] = counts.get(nxt, 0) + counts.pop(lo)
+
+
+def _sketch_merge(into: dict, other: dict) -> None:
+    into["z"] += other.get("z", 0)
+    c = into["c"]
+    for k, v in other.get("c", {}).items():
+        k = int(k)  # JSON round trips stringify int keys
+        c[k] = c.get(k, 0) + v
+
+
+def sketch_percentile(sk: Optional[dict], q: float) -> Optional[float]:
+    """q-th percentile (q in [0,1]) from a sketch, accurate to
+    ±_SKETCH_ALPHA relative error; None for an empty/missing sketch."""
+    if not sk:
+        return None
+    counts = {int(k): v for k, v in sk.get("c", {}).items()}
+    zero = sk.get("z", 0)
+    total = zero + sum(counts.values())
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = zero
+    if cum >= rank and zero:
+        return 0.0
+    for idx in sorted(counts):
+        cum += counts[idx]
+        if cum >= rank:
+            return _sketch_value(idx)
+    return _sketch_value(max(counts)) if counts else 0.0
 
 # shared latency bucket boundaries (ms) for the built-in SLO histograms
 # (serve router/replica/proxy, raylet lease grants, cgraph execute): sub-ms
@@ -64,6 +142,14 @@ KNOWN_METRICS: Dict[str, str] = {
     "serve_circuit_open": "replicas ejected by an open breaker",
     "serve_exec_latency_ms": "user-callable latency at the replica",
     "serve_replica_ongoing": "requests executing in a replica",
+    # serve fast-path dispatch (compiled/transport plane)
+    "serve_fastpath_requests_total": "requests dispatched over compiled "
+                                     "channels",
+    "serve_fastpath_fallbacks_total": "fast-path requests that degraded to "
+                                      "the router slow path",
+    "serve_fastpath_channels": "warmed (deployment, replica) compiled "
+                               "channels",
+    "serve_ongoing_streams": "open streaming responses in a replica",
     "serve_http_requests_total": "HTTP requests by route and code",
     "serve_http_latency_ms": "HTTP dispatch latency at the proxy",
     # raylet / object store
@@ -112,6 +198,10 @@ class _Series:
         # counter/gauge: tags -> float
         # histogram: tags -> [bucket_counts..., +inf_count, sum, count]
         self.points: Dict[_TagTuple, object] = {}
+        # histogram only: tags -> quantile sketch {"z": int, "c": {idx: n}}
+        # (kept beside the exposition buckets, never instead of them — the
+        # /metrics endpoint's format is bucket-defined)
+        self.sketches: Dict[_TagTuple, dict] = {}
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -119,13 +209,20 @@ class _Series:
                 k: (list(v) if isinstance(v, list) else v)
                 for k, v in self.points.items()
             }
-        return {
+            sks = {
+                k: {"z": v["z"], "c": dict(v["c"])}
+                for k, v in self.sketches.items()
+            }
+        out = {
             "name": self.name,
             "kind": self.kind,
             "description": self.description,
             "boundaries": self.boundaries,
             "points": pts,
         }
+        if sks:
+            out["sketches"] = sks
+        return out
 
 
 class MetricsRegistry:
@@ -239,14 +336,15 @@ class Histogram(_Metric):
             if pt is None:
                 pt = [0] * (len(s.boundaries) + 1) + [0.0, 0]
                 s.points[key] = pt
-            idx = len(s.boundaries)
-            for i, b in enumerate(s.boundaries):
-                if value <= b:
-                    idx = i
-                    break
-            pt[idx] += 1
+            # C-level bisect replaces the Python boundary loop (hot path:
+            # every serve request / raylet lease observes)
+            pt[bisect.bisect_left(s.boundaries, value)] += 1
             pt[-2] += value
             pt[-1] += 1
+            sk = s.sketches.get(key)
+            if sk is None:
+                sk = s.sketches[key] = {"z": 0, "c": {}}
+            _sketch_observe(sk, value)
 
 
 # ----------------------------------------------------------------------- #
@@ -266,7 +364,7 @@ def merge_snapshots(per_source: Dict[str, Tuple[float, List[dict]]],
         for snap in series_list:
             m = merged.setdefault(
                 snap["name"],
-                {**snap, "points": {}},
+                {**snap, "points": {}, "sketches": {}},
             )
             for tags, val in snap["points"].items():
                 if snap["kind"] == "gauge":
@@ -280,7 +378,19 @@ def merge_snapshots(per_source: Dict[str, Tuple[float, List[dict]]],
                         m["points"][tags] = [a + b for a, b in zip(cur, val)]
                 else:
                     m["points"][tags] = m["points"].get(tags, 0.0) + val
-    return list(merged.values())
+            for tags, sk in (snap.get("sketches") or {}).items():
+                cur = m["sketches"].get(tags)
+                if cur is None:
+                    m["sketches"][tags] = {"z": sk.get("z", 0),
+                                           "c": dict(sk.get("c", {}))}
+                else:
+                    _sketch_merge(cur, sk)
+    out = []
+    for m in merged.values():
+        if not m.get("sketches"):
+            m.pop("sketches", None)  # counters/gauges: no empty clutter
+        out.append(m)
+    return out
 
 
 def _escape_tag_value(v: str) -> str:
@@ -450,22 +560,76 @@ def histogram_percentile(boundaries: Sequence[float], counts: Sequence[float],
     return boundaries[-1] if boundaries else None
 
 
+def _find_sketch(sample: dict, name: str,
+                 tags: Optional[Dict[str, str]] = None) -> Optional[dict]:
+    """Summed quantile sketch for one sample (tag-superset selection like
+    _find_points), or None when the series carries no sketches (e.g. it
+    crossed a JSON boundary that drops additive fields)."""
+    for s in sample.get("series", ()):
+        if s["name"] != name:
+            continue
+        want = set((tags or {}).items())
+        acc: Optional[dict] = None
+        for ptags, sk in (s.get("sketches") or {}).items():
+            if not want <= set(ptags):
+                continue
+            if acc is None:
+                acc = {"z": sk.get("z", 0), "c": dict(sk.get("c", {}))}
+            else:
+                _sketch_merge(acc, sk)
+        return acc
+    return None
+
+
+def _sketch_delta(last: dict, first: Optional[dict]) -> dict:
+    """Sketch of what happened BETWEEN two cumulative sketches (clamped at
+    zero per bucket — a restart resets the counters)."""
+    if first is None:
+        return last
+    fc = {int(k): v for k, v in first.get("c", {}).items()}
+    counts = {
+        int(k): v - fc.get(int(k), 0)
+        for k, v in last.get("c", {}).items()
+        if v - fc.get(int(k), 0) > 0
+    }
+    return {"z": max(0, last.get("z", 0) - first.get("z", 0)), "c": counts}
+
+
 def window_percentile(samples: List[dict], name: str, q: float,
                       tags: Optional[Dict[str, str]] = None,
                       ) -> Optional[float]:
     """Percentile of a histogram series OVER the sample window: the bucket
     deltas between the window's first and last samples (what happened in the
     window), falling back to the cumulative last sample when the series only
-    appears once."""
+    appears once. When the samples carry quantile sketches the estimate is
+    sketch-based (±1% relative accuracy on the tails) instead of linear
+    interpolation inside an exposition bucket."""
     seen = []
+    sk_seen = []
     boundaries = None
     for s in samples:
         series, v = _find_points(s, name, tags)
         if v is not None:
             boundaries = series.get("boundaries") or boundaries
             seen.append(v)
+            sk_seen.append(_find_sketch(s, name, tags))
     if not seen or boundaries is None:
         return None
+    # sketch path: accurate tails, same window-delta semantics. Requires a
+    # sketch on BOTH window endpoints (or a single-sample window) — a
+    # sketchless first sample (pre-upgrade snapshot, JSON-crossing source)
+    # would silently turn "the window's p99" into the all-time cumulative
+    # p99, so that case falls back to bucket deltas instead.
+    if sk_seen and sk_seen[-1] is not None \
+            and (len(sk_seen) == 1 or sk_seen[0] is not None):
+        delta = _sketch_delta(
+            sk_seen[-1], sk_seen[0] if len(sk_seen) > 1 else None
+        )
+        if delta.get("z", 0) + sum(delta.get("c", {}).values()) <= 0:
+            delta = sk_seen[-1]  # nothing in the window: cumulative
+        est = sketch_percentile(delta, q)
+        if est is not None:
+            return est
     last = seen[-1]
     nb = len(boundaries) + 1  # + the +Inf bucket; tail is [sum, count]
     counts = list(last[:nb])

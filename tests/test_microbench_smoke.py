@@ -1,0 +1,46 @@
+"""Microbenchmark schema check (tier-1 CI node).
+
+Runs ``python -m ray_tpu.microbenchmark --smoke --json`` — every section on
+a tiny config — and asserts the emitted row-name set matches the module's
+EXPECTED_ROWS registry exactly. No performance assertions (so it cannot
+flake on a loaded box); what it catches is silent schema drift: a renamed,
+dropped, or never-run row would otherwise corrupt MICROBENCH.json
+comparisons across PRs without failing anything.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from ray_tpu.microbenchmark import EXPECTED_ROWS
+
+
+def test_smoke_emits_every_known_row(tmp_path):
+    out = tmp_path / "smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.microbenchmark", "--smoke",
+         "--json", str(out)],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"smoke run failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    payload = json.loads(out.read_text())
+    rows = payload["microbenchmark"]
+    names = [r["name"] for r in rows]
+    assert sorted(names) == sorted(set(names)), "duplicate row names"
+    missing = set(EXPECTED_ROWS) - set(names)
+    unexpected = set(names) - set(EXPECTED_ROWS)
+    assert not missing and not unexpected, (
+        f"microbenchmark schema drift: missing={sorted(missing)} "
+        f"unexpected={sorted(unexpected)} — update EXPECTED_ROWS and "
+        "MICROBENCH.json together"
+    )
+    # every row carries at least one numeric field beyond its name
+    for r in rows:
+        assert any(
+            isinstance(v, (int, float)) for k, v in r.items() if k != "name"
+        ), f"row {r['name']!r} has no numeric payload: {r}"

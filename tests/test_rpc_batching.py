@@ -397,3 +397,45 @@ def test_vectored_flush_integrity_under_partial_writes():
             await server.close()
 
     _run(run())
+
+
+def test_adaptive_coalesce_delay_per_connection():
+    """PR-13: the gather window adapts PER CONNECTION — a connection whose
+    recent flushes carried many frames each (reply fan-in) stretches its
+    delay to rpc_adaptive_coalesce_max_ms; an idle/request-response
+    connection flushes on the next tick; adaptive off restores the fixed
+    global delay for everyone."""
+    from ray_tpu.core.config import _config
+    from ray_tpu.core.rpc import Connection
+
+    conn = Connection(None, None, name="test-adaptive")
+    saved = (_config.rpc_adaptive_coalesce, _config.rpc_coalesce_delay_ms,
+             _config.rpc_adaptive_coalesce_max_ms,
+             _config.rpc_adaptive_coalesce_min_frames)
+    try:
+        _config.rpc_adaptive_coalesce = True
+        _config.rpc_coalesce_delay_ms = 0.0
+        _config.rpc_adaptive_coalesce_max_ms = 0.5
+        _config.rpc_adaptive_coalesce_min_frames = 6.0
+        # idle connection: no history -> immediate flush
+        assert conn._coalesce_delay_s() == 0.0
+        # busy connection: EWMA of frames/flush over the threshold
+        conn._flush_ewma = 12.0
+        assert conn._coalesce_delay_s() == 0.0005
+        # decayed back under the threshold -> immediate again
+        conn._flush_ewma = 2.0
+        assert conn._coalesce_delay_s() == 0.0
+        # adaptive off: the fixed floor applies regardless of busyness
+        _config.rpc_adaptive_coalesce = False
+        conn._flush_ewma = 50.0
+        assert conn._coalesce_delay_s() == 0.0
+        _config.rpc_coalesce_delay_ms = 1.0
+        assert conn._coalesce_delay_s() == 0.001
+        # fixed floor is never LOWERED by the adaptive path
+        _config.rpc_adaptive_coalesce = True
+        _config.rpc_coalesce_delay_ms = 2.0
+        assert conn._coalesce_delay_s() == 0.002
+    finally:
+        (_config.rpc_adaptive_coalesce, _config.rpc_coalesce_delay_ms,
+         _config.rpc_adaptive_coalesce_max_ms,
+         _config.rpc_adaptive_coalesce_min_frames) = saved

@@ -509,3 +509,88 @@ def test_samples_from_dashboard_json_roundtrip():
     p50 = window_percentile(samples, "serve_request_latency_ms", 0.5,
                             {"deployment": "d"})
     assert p50 is not None and 1.0 <= p50 <= 10.0
+
+
+def test_quantile_sketch_accuracy_and_merge():
+    """PR-13: histograms carry a DDSketch-style quantile sketch beside the
+    exposition buckets — tail percentiles come out within ~1% relative
+    error instead of bucket interpolation (a p99 inside the 1000..2500ms
+    bucket used to be anywhere in a 2.5x span)."""
+    import random
+
+    from ray_tpu.util import metrics as m
+
+    h = m.Histogram("sketch_test_lat_ms", boundaries=[1, 10, 100, 1000],
+                    tag_keys=("k",))
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(3.0, 1.2) for _ in range(4000)]
+    for v in vals:
+        h.observe(v, {"k": "a"})
+    snap = next(
+        s for s in m.get_registry().collect()
+        if s["name"] == "sketch_test_lat_ms"
+    )
+    assert "sketches" in snap
+    sk = snap["sketches"][(("k", "a"),)]
+    vals.sort()
+    for q in (0.5, 0.9, 0.99):
+        est = m.sketch_percentile(sk, q)
+        true = vals[int(q * (len(vals) - 1))]
+        assert abs(est - true) / true < 0.03, (q, est, true)
+    # exposition buckets stay exact (the /metrics contract is unchanged):
+    # bucket counts sum to the observation count
+    pt = snap["points"][(("k", "a"),)]
+    assert sum(pt[:-2]) == pt[-1] == len(vals)
+
+    # merge: sketches sum bucket-wise across sources like histograms do
+    import time as _t
+
+    merged = m.merge_snapshots({
+        "s1": (_t.time(), [snap]), "s2": (_t.time(), [snap]),
+    })
+    msnap = next(s for s in merged if s["name"] == "sketch_test_lat_ms")
+    msk = msnap["sketches"][(("k", "a"),)]
+    assert sum(msk["c"].values()) == 2 * sum(sk["c"].values())
+    est = m.sketch_percentile(msk, 0.99)
+    true = vals[int(0.99 * (len(vals) - 1))]
+    assert abs(est - true) / true < 0.03  # merging two copies moves nothing
+
+
+def test_window_percentile_prefers_sketch_and_falls_back():
+    """window_percentile uses sketch deltas when present (accurate tails)
+    and keeps the bucket-interpolation fallback for sketchless samples
+    (e.g. series that crossed the dashboard's JSON boundary)."""
+    from ray_tpu.util import metrics as m
+
+    boundaries = [1, 10, 100, 1000]
+
+    def series(count_hi, sketch):
+        # one point: `count_hi` observations in the 100..1000 bucket
+        s = {"name": "wp_sketch_test", "kind": "histogram",
+             "boundaries": boundaries,
+             "points": {(): [0, 0, 0, count_hi, 0, 0.0, count_hi]}}
+        if sketch is not None:
+            s["sketches"] = {(): sketch}
+        return s
+
+    def sk_of(values):
+        sk = {"z": 0, "c": {}}
+        for v in values:
+            idx = m._sketch_index(v)
+            sk["c"][idx] = sk["c"].get(idx, 0) + 1
+        return sk
+
+    first = {"ts": 100.0, "series": [series(10, sk_of([500.0] * 10))]}
+    last = {"ts": 110.0, "series": [
+        series(30, sk_of([500.0] * 10 + [880.0] * 20))
+    ]}
+    p = m.window_percentile([first, last], "wp_sketch_test", 0.5)
+    # the WINDOW saw only the 880ms observations: the sketch knows that
+    # within 1%, bucket interpolation could only say "100..1000"
+    assert p is not None and abs(p - 880.0) / 880.0 < 0.02, p
+
+    # sketchless fallback: same samples without sketches interpolate
+    first_nb = {"ts": 100.0, "series": [series(10, None)]}
+    last_nb = {"ts": 110.0, "series": [series(30, None)]}
+    p2 = m.window_percentile([first_nb, last_nb], "wp_sketch_test", 0.5)
+    assert p2 is not None and 100.0 <= p2 <= 1000.0
