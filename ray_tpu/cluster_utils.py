@@ -67,25 +67,42 @@ class Cluster:
         if p is not None:
             p.kill()
 
+    @property
+    def gcs_store_path(self) -> str:
+        """The head's durable store (snapshot + WAL segments live beside
+        it) — what ``scripts head-state`` reads offline."""
+        return os.path.join(self.procs.session_dir, "gcs_store.pkl")
+
     def kill_gcs(self):
-        """SIGKILL the GCS process (fault-tolerance chaos testing)."""
+        """SIGKILL the GCS process (fault-tolerance chaos testing). A real
+        kill: there is no pre-exit snapshot flush anywhere anymore —
+        acknowledged durability comes from the write-ahead log alone."""
         p = self._gcs_proc or self.procs.procs[0]  # start_gcs spawns first
         p.kill()
         p.wait(timeout=10)
 
+    def wait_gcs_exit(self, timeout: float = 30.0) -> bool:
+        """Wait for the GCS process to die (chaos plans kill it from the
+        inside — the test must not restart over a still-running head)."""
+        p = self._gcs_proc or self.procs.procs[0]
+        deadline = time.monotonic() + timeout
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        return p.poll() is not None
+
     def restart_gcs(self):
         """Restart the GCS on the SAME port with the same snapshot store;
-        raylets/drivers re-register through their reconnect loops."""
+        raylets/drivers re-register through their reconnect loops and the
+        WAL replay restores every acknowledged mutation."""
         import sys
 
         from ray_tpu.core.cluster_backend import daemon_env
 
         port = self.gcs_address.rsplit(":", 1)[1]
-        store = os.path.join(self.procs.session_dir, "gcs_store.pkl")
         self._gcs_proc = self.procs.spawn(
             "gcs-restarted",
             [sys.executable, "-m", "ray_tpu.core.gcs.server",
-             "--port", port, "--store", store],
+             "--port", port, "--store", self.gcs_store_path],
             env=daemon_env(),
         )
 

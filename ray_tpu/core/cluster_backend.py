@@ -320,7 +320,7 @@ class ClusterBackend(Backend):
                     "get_actor", actor_id=actor_id.binary(), timeout=30
                 )
             )
-        except (rpc.RpcError, rpc.ConnectionLost):
+        except (rpc.RpcError, rpc.ConnectionLost, exc.GcsUnavailableError):
             # a GCS blip must NOT read as actor death: callers treat
             # UNKNOWN as maybe-alive (retry/wait), never as terminal
             return "UNKNOWN"
@@ -328,6 +328,7 @@ class ClusterBackend(Backend):
 
     def wait_actor_alive(self, actor_id, timeout: float) -> None:
         deadline = time.monotonic() + timeout
+        attempt = 0
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -342,8 +343,14 @@ class ClusterBackend(Backend):
                         wait_timeout=min(remaining, 10.0), timeout=30,
                     )
                 )
-            except (rpc.RpcError, rpc.ConnectionLost):
-                time.sleep(0.2)
+                attempt = 0
+            except (rpc.RpcError, rpc.ConnectionLost,
+                    exc.GcsUnavailableError):
+                # head restarting: wait out the reattach window behind the
+                # standard jittered backoff instead of a fixed tick
+                attempt += 1
+                time.sleep(min(remaining,
+                               self.core._backoff().delay(attempt)))
                 continue
             if info is None or info["state"] == "DEAD":
                 reason = (info or {}).get("death_reason", "") or "dead"
@@ -358,7 +365,7 @@ class ClusterBackend(Backend):
                     "get_actor", actor_id=actor_id.binary(), timeout=30
                 )
             )
-        except (rpc.RpcError, rpc.ConnectionLost):
+        except (rpc.RpcError, rpc.ConnectionLost, exc.GcsUnavailableError):
             return None
         return None if info is None else info.get("node_id")
 

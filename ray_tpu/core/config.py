@@ -142,6 +142,38 @@ class Config:
     # than this severs the stream (typed error, never a silent hang)
     transport_io_timeout_s: float = 120.0
 
+    # --- head-plane durability (GCS snapshot + WAL, core/gcs/) -------------
+    # master switch for the write-ahead log: every durable-table mutation
+    # (kv, functions, detached actors/PGs, named actors, job counter,
+    # channel endpoints) appends a framed record before the RPC reply, so
+    # an unclean GCS death loses zero acknowledged mutations
+    gcs_wal_enabled: bool = True
+    # fsync every WAL record (survives machine power loss, not just process
+    # death) — off by default: the page cache already survives SIGKILL, and
+    # a per-mutation fsync caps kv throughput at disk latency
+    gcs_wal_fsync: bool = False
+    # compaction triggers: a full-table snapshot (which also captures the
+    # metrics ring, task-event aggregator, and shipped node WAL tails)
+    # replaces the log when the active segment outgrows this...
+    gcs_wal_max_bytes: int = 8 * 1024 * 1024
+    # ...or this much time passed since the last snapshot with mutations
+    # pending (the old lossy 1s _snapshot_loop cadence, now only a bound on
+    # replay length rather than on durability)
+    gcs_snapshot_interval_s: float = 15.0
+    # raylet -> GCS task-event WAL tail shipping (whole-node-loss
+    # forensics): how often each raylet ships its workers' unflushed WAL
+    # tails, and the per-worker byte bound on one shipment
+    task_events_wal_ship_interval_ms: int = 2_000
+    task_events_wal_ship_max_bytes: int = 256 * 1024
+
+    # --- deadline clock-skew guard ------------------------------------------
+    # absolute deadlines are wall-clock epoch seconds minted by the owner;
+    # a receiving host whose clock disagrees with the owner's by more than
+    # this (estimated from the spec's minted (wall, mono) pair) re-anchors
+    # the remaining budget to its own clock instead of falsely shedding
+    # (task_spec.effective_deadline)
+    deadline_skew_tolerance_s: float = 5.0
+
     # --- timeouts / health --------------------------------------------------
     health_check_period_ms: int = 1_000
     health_check_failure_threshold: int = 5

@@ -279,6 +279,17 @@ class CoreWorker:
             self._retry_policy = backoff.BackoffPolicy()
         return self._retry_policy
 
+    @staticmethod
+    def _stamp_deadline_clocks(spec: ts.TaskSpec) -> None:
+        """Deadline-carrying specs record the owner's wall AND monotonic
+        clocks at submission, so a receiving host can re-anchor the
+        deadline into its own clock domain (ts.effective_deadline) instead
+        of trusting raw cross-host wall-clock comparison (NTP skew guard)."""
+        if spec.deadline is None:
+            return
+        spec.deadline_minted_wall = time.time()
+        spec.deadline_minted_mono = time.monotonic()
+
     def _shed_expired(self, spec: ts.TaskSpec) -> bool:
         """Owner-side admission: True when the spec's deadline has already
         passed — the caller sheds it typed instead of dispatching work
@@ -327,6 +338,7 @@ class CoreWorker:
         if self.mode == "driver":
             reply = await self.gcs.call("register_driver")
             if isinstance(reply, dict) and reply.get("job_id") is not None:
+                self._job_num = reply["job_id"]  # for idempotent re-register
                 self.job_id = f"{reply['job_id']:04x}"
             await self._subscribe_logs()
         for loop_coro in (
@@ -423,16 +435,24 @@ class CoreWorker:
                     name=f"{self.mode}->gcs", retries=5, retry_delay=0.5,
                 )
                 if self.mode == "driver":
-                    await self.gcs.call("register_driver")
+                    # idempotent re-register: the driver KEEPS its job id
+                    # (a second mint would split this driver's task history
+                    # and retention across two jobs)
+                    await self.gcs.call(
+                        "register_driver",
+                        job_id=getattr(self, "_job_num", None),
+                    )
                     await self._subscribe_logs()
                 if self._actor_listeners:
                     try:
                         await self._subscribe_actor_events()
                     except (rpc.RpcError, rpc.ConnectionLost):
                         pass
-                # functions registered <1s before the crash may have missed
-                # the snapshot: re-register everything we know from cache so
-                # outstanding fn_ids stay resolvable
+                # belt-and-suspenders: the GCS WAL makes acknowledged
+                # registrations durable, but one whose reply raced the
+                # crash was never acknowledged — re-register everything we
+                # know from cache so outstanding fn_ids stay resolvable
+                # even against a WAL-disabled head
                 for fn_id, blob in list(self._registered_blobs.items()):
                     try:
                         await self.gcs.call(
@@ -440,6 +460,13 @@ class CoreWorker:
                         )
                     except (rpc.RpcError, rpc.ConnectionLost):
                         break
+                if _config.metrics_enabled:
+                    from ray_tpu.util.metrics import Counter
+
+                    Counter(
+                        "gcs_reconnects_total",
+                        "successful re-dials of a restarted GCS",
+                    ).inc(1.0)
                 logger.warning("reconnected to GCS at %s", self.gcs_address)
             except rpc.ConnectionLost:
                 pass
@@ -1020,15 +1047,24 @@ class CoreWorker:
 
     async def _gcs_call_retrying(self, method, attempts: int = 10, **kw):
         """GCS call that rides out a fault-tolerance restart window (the
-        watchdog re-dials within ~1s)."""
+        watchdog re-dials within ~1s). In-flight control-plane waiters —
+        ``get_actor``, ``get_channel_endpoint``, function/kv registration —
+        all funnel through here: a connection torn mid-call retries behind
+        the standard jittered backoff policy and, if the head never comes
+        back, fails TYPED (GcsUnavailableError) instead of leaking a raw
+        ConnectionLost to the caller."""
         last: Optional[BaseException] = None
-        for _ in range(attempts):
+        for attempt in range(1, attempts + 1):
             try:
                 return await self.gcs.call(method, **kw)
             except rpc.ConnectionLost as e:
                 last = e
-                await asyncio.sleep(0.5)
-        raise last
+                if attempt < attempts:
+                    await asyncio.sleep(self._backoff().delay(attempt))
+        raise exc.GcsUnavailableError(
+            f"GCS at {self.gcs_address} unreachable across {attempts} "
+            f"attempts of {method!r}"
+        ) from last
 
     def _pack_runtime_env(self, options: RemoteOptions) -> Optional[dict]:
         """Zip+upload runtime_env packages once per env (content-addressed
@@ -1103,6 +1139,7 @@ class CoreWorker:
             job_id=self.job_id or tracing.current_job_id(),
             deadline=tracing.current_deadline(),
         )
+        self._stamp_deadline_clocks(spec)
         self.submitted_specs[task_id] = spec
         self._pin_task_args(task_id, enc_args, enc_kwargs)
         self._record_task_event(spec, "SUBMITTED")
@@ -2119,6 +2156,7 @@ class CoreWorker:
             job_id=self.job_id or tracing.current_job_id(),
             deadline=tracing.current_deadline(),
         )
+        self._stamp_deadline_clocks(spec)
         self._record_task_event(spec, "SUBMITTED")
         out = None
         if streaming:

@@ -3,8 +3,14 @@
 Parity: src/ray/gcs/gcs_server/ (gcs_server.cc:133-178 wires the same manager
 set): node membership + health checks, KV store, function registry, actor
 lifecycle + restarts, placement groups, resource view aggregation, pubsub.
-Single asyncio process; all state in memory (Redis-backed persistence is a
-later flag, mirroring gcs_storage="memory" default in ray_config_def.h:398).
+Single asyncio process. Durability (the reference's Redis store_client,
+src/ray/gcs/store_client/): every durable-table mutation appends to a
+write-ahead log BEFORE its RPC reply is sent (core/gcs/wal.py), and a
+periodic compaction replaces the log with a full-table snapshot that also
+captures the soft state worth keeping across a restart (metrics ring,
+task-event aggregator, shipped node WAL tails). Restore = snapshot + WAL
+replay, tolerant of a torn final record — an unclean GCS death at ANY
+instruction loses zero acknowledged mutations.
 
 Connections are bidirectional: raylets register once and the same connection
 carries GCS→raylet commands (create worker, kill, reserve bundle) — no
@@ -17,6 +23,7 @@ import asyncio
 import logging
 import os
 import pickle
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -148,30 +155,53 @@ class GcsServer:
 
         self.timeseries = MetricsTimeSeries()
         self._store_dirty = True  # durable-table mutation since last snapshot
+        # snapshot installs are serialized + ordered: the compaction loop
+        # writes off-loop while close() writes synchronously on the loop
+        # (task.cancel() does not stop an already-running executor thread,
+        # and both paths share the same .tmp file); the generation counter
+        # keeps a stale in-flight capture from clobbering a newer snapshot
+        self._snap_lock = threading.Lock()
+        self._snap_gen = 0  # bumped at capture time, on the event loop only
+        self._snap_installed = 0  # generation of the snapshot on disk
+        # write-ahead log (opened in start() after restore+replay); None
+        # when persistence is off — mutations then live only in memory
+        self.wal = None
+        # whole-node-loss forensics: raylets periodically ship their
+        # workers' unflushed task-event WAL tails here (node_id → {wal
+        # file name → [events]}, replace semantics per shipment); when a
+        # node dies uncleanly the stored tails are ingested into the
+        # aggregator so the dead node's final task states still close
+        # their timelines. Rides the snapshot, not the WAL (high churn).
+        self.node_wal_tails: Dict[str, Dict[str, list]] = {}
         self._actor_events: Dict[bytes, asyncio.Event] = {}  # get_actor waits
         # cross-node stream-channel endpoint registry (core/transport/):
         # a channel reader advertises (host, port, node) here at materialize
         # time; the writer blocks in get_channel_endpoint until it appears.
-        # In-memory only — channel ids are epoch-scoped, a restarted GCS
-        # simply sees fresh registrations from the next materialize.
+        # Durable (WAL ep_put/ep_close/ep_del/ep_drop + snapshot): a graph
+        # materialized before a GCS crash stays resolvable by late writers
+        # after the restart — including the close tombstones that make a
+        # torn-down channel's stragglers exit typed.
         self.channel_endpoints: Dict[str, dict] = {}
         self._endpoint_events: Dict[str, asyncio.Event] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
         if self.store_path:
-            self._restore_store()
-        # chaos "exit" action (restart_gcs injection): crash AFTER flushing
-        # the durable snapshot — the deterministic analog of the old
-        # sleep-until-snapshot-then-SIGKILL test pattern
-        from ray_tpu.testing import chaos
+            wal_seq = self._restore_store()
+            if _config.gcs_wal_enabled:
+                wal_seq = self._replay_wal(wal_seq)
+                from ray_tpu.core.gcs.wal import GcsWal
 
-        chaos.set_exit_callback(self._chaos_pre_exit)
+                self.wal = GcsWal(self._wal_base())
+                self.wal.open(wal_seq)
+            else:
+                self._fold_leftover_wal(wal_seq)
+            self._schedule_restored()
         await self.server.start()
         self._bg.append(asyncio.create_task(self._health_check_loop()))
         self._bg.append(asyncio.create_task(self._metrics_sample_loop()))
         if self.store_path:
-            self._bg.append(asyncio.create_task(self._snapshot_loop()))
+            self._bg.append(asyncio.create_task(self._compaction_loop()))
         logger.info("GCS listening on %s", self.server.address)
         return self.server.address
 
@@ -180,32 +210,137 @@ class GcsServer:
             t.cancel()
         if self.store_path:
             self._write_snapshot()
+        if self.wal is not None:
+            self.wal.close()
         await self.server.close()
 
-    def _chaos_pre_exit(self) -> None:
-        if self.store_path:
-            self._write_snapshot()
-
     # --------------------------------------------------- fault tolerance
+    def _wal_base(self) -> str:
+        return self.store_path + ".wal"
+
+    def _append_wal(self, op: str, **data) -> None:
+        """Durably log one table mutation. Called INSIDE the mutating
+        handler, before it returns — the rpc reply (= the caller's
+        acknowledgement) is only queued after the handler finishes, so an
+        acknowledged mutation is always on disk."""
+        if self.wal is not None:
+            self.wal.append(op, data)
+
+    def _fold_leftover_wal(self, after_seq: int) -> None:
+        """`gcs_wal_enabled` was toggled OFF across a restart but segments
+        from the previous (enabled) run exist: they hold acknowledged
+        mutations past the snapshot. Skipping them would silently lose
+        those mutations, and leaving them on disk is worse — snapshots
+        written while disabled carry wal_seq=0, so a later re-ENABLED
+        restart would replay the stale records over newer state,
+        resurrecting deleted keys and dead actors. Replay them now, fold
+        them into a fresh snapshot, and delete them."""
+        from ray_tpu.core.gcs import wal as wal_mod
+
+        segs = wal_mod.list_segments(self._wal_base())
+        if not segs:
+            return
+        logger.warning(
+            "GCS WAL disabled but %d segment(s) from a previous run exist; "
+            "replaying + folding them into the snapshot", len(segs),
+        )
+        self._replay_wal(after_seq)
+        if self._write_snapshot_state(self._snapshot_state(0)):
+            for _, path in segs:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    def _replay_wal(self, after_seq: int) -> int:
+        from ray_tpu.core.gcs import wal as wal_mod
+
+        replayed = 0
+        for seq, op, data in wal_mod.replay(self._wal_base(), after_seq):
+            try:
+                self._apply_wal(op, data)
+            except Exception:  # noqa: BLE001 - one bad record: keep going
+                logger.exception("WAL replay failed for op %r seq %d",
+                                 op, seq)
+            after_seq = seq
+            replayed += 1
+        if replayed:
+            logger.info("GCS WAL replay: %d record(s) past snapshot", replayed)
+            if _config.metrics_enabled:
+                from ray_tpu.util.metrics import Counter
+
+                Counter(
+                    "gcs_wal_replayed_total",
+                    "WAL records replayed on GCS restore",
+                ).inc(float(replayed))
+        return after_seq
+
+    def _apply_wal(self, op: str, d: dict) -> None:
+        """Replay one durable record. Every op is an idempotent state SET
+        (never an increment), so snapshot/replay overlap converges."""
+        if op == "kv_put":
+            self.kv[(d["ns"], d["key"])] = d["value"]
+        elif op == "kv_del":
+            self.kv.pop((d["ns"], d["key"]), None)
+        elif op == "fn":
+            self.functions[d["fn_id"]] = d["blob"]
+        elif op == "job":
+            self.job_counter = max(self.job_counter, int(d["value"]))
+        elif op == "actor_put":
+            self._restore_actor(d["aid"], d["entry"])
+        elif op == "actor_dead":
+            info = self.actors.pop(d["aid"], None)
+            if info is not None and info.name and self.named_actors.get(
+                    (info.namespace, info.name)) == d["aid"]:
+                del self.named_actors[(info.namespace, info.name)]
+        elif op == "pg_put":
+            e = d["entry"]
+            self.placement_groups[d["pg_id"]] = PlacementGroupInfo(
+                pg_id=d["pg_id"], bundles=e["bundles"],
+                strategy=e["strategy"], detached=True,
+                placement=e.get("placement"),
+                state="CREATED" if e.get("placement") else "PENDING",
+            )
+        elif op == "pg_del":
+            self.placement_groups.pop(d["pg_id"], None)
+        elif op == "ep_put":
+            self.channel_endpoints[d["channel_id"]] = d["entry"]
+        elif op == "ep_close":
+            self.channel_endpoints[d["channel_id"]] = {
+                "closed": True, "owner": "",
+            }
+        elif op == "ep_del":
+            self.channel_endpoints.pop(d["channel_id"], None)
+        elif op == "ep_drop":
+            for entry in self.channel_endpoints.values():
+                if entry.get("owner") == d["owner"] and "dropped" not in entry:
+                    entry["dropped"] = d.get("reason") or "owner worker died"
+        else:
+            logger.warning("unknown WAL op %r ignored", op)
+
+    @staticmethod
+    def _actor_entry(i: "ActorInfo") -> dict:
+        return {
+            "spec_blob": i.spec_blob,
+            "name": i.name,
+            "namespace": i.namespace,
+            "max_restarts": i.max_restarts,
+            "restarts_left": i.restarts_left,
+            "resources": i.resources,
+            "pg_id": i.pg_id,
+            "bundle_index": i.bundle_index,
+            # adoption hint: reschedule on the node whose live worker
+            # still runs this actor, never a duplicate elsewhere
+            "node_id": i.node_id,
+        }
+
     def _durable_state(self) -> dict:
         """Tables that must survive a GCS restart. Nodes/connections are NOT
         persisted: raylets and drivers re-register through their reconnect
         loops. Detached actors/PGs are restored PENDING and reschedule as
         nodes come back (parity: gcs/store_client tables)."""
         detached_actors = {
-            aid: {
-                "spec_blob": i.spec_blob,
-                "name": i.name,
-                "namespace": i.namespace,
-                "max_restarts": i.max_restarts,
-                "restarts_left": i.restarts_left,
-                "resources": i.resources,
-                "pg_id": i.pg_id,
-                "bundle_index": i.bundle_index,
-                # adoption hint: reschedule on the node whose live worker
-                # still runs this actor, never a duplicate elsewhere
-                "node_id": i.node_id,
-            }
+            aid: self._actor_entry(i)
             for aid, i in self.actors.items()
             if i.detached and i.state != DEAD
         }
@@ -230,73 +365,216 @@ class GcsServer:
                 if v in detached_actors
             },
             "placement_groups": detached_pgs,
+            # cross-node channel endpoint registry: restored so compiled
+            # graphs / serve fast-path channels materialized before the
+            # crash stay resolvable by late writers (the ROADMAP "GCS
+            # restart drops the endpoint registry" gap)
+            "channel_endpoints": {
+                k: dict(v) for k, v in self.channel_endpoints.items()
+            },
         }
 
+    def _snapshot_state(self, wal_seq: int,
+                        include_heavy: bool = True) -> dict:
+        """Full-table snapshot: the durable tables plus the soft state a
+        restarted head should not forget — the metrics time-series ring,
+        the task-event aggregator, the last metric report per source, and
+        the shipped node WAL tails. ``wal_seq`` marks the WAL prefix this
+        snapshot covers (replay skips records at or below it). With
+        ``include_heavy=False`` the lock-guarded heavy copy-outs are left
+        for the caller to run off-loop via :meth:`_snapshot_heavy` — both
+        snapshot paths share THIS field list, so a new soft-state field
+        added here reaches the compaction path too."""
+        state = self._durable_state()
+        state["wal_seq"] = int(wal_seq)
+        state["metrics"] = dict(self.metrics)
+        state["metric_reports"] = dict(self.metric_reports)
+        state["node_wal_tails"] = {
+            n: dict(t) for n, t in self.node_wal_tails.items()
+        }
+        if include_heavy:
+            self._snapshot_heavy(state)
+        return state
+
+    def _snapshot_heavy(self, state: dict) -> None:
+        """The task-event + timeseries copy-outs: guarded by their own
+        locks (safe off the event loop), and the aggregator copy grows
+        with retained history — the compaction path runs these in the
+        executor so they never stall heartbeat/scheduling rpcs."""
+        state["timeseries"] = self.timeseries.dump()
+        state["task_events"] = self.task_events.dump()
+
     def _write_snapshot(self) -> None:
+        """Synchronous snapshot (graceful close path); the running server
+        compacts through _compaction_loop instead."""
+        self._snap_gen += 1
+        gen = self._snap_gen
+        seq = self.wal.rotate() if self.wal is not None else 0
+        self._install_snapshot(gen, self._snapshot_state(seq), seq)
+
+    def _install_snapshot(self, gen: int, state: dict, seq: int) -> None:
+        """Write one captured snapshot and prune the WAL prefix it covers.
+        The lock serializes the close path against an in-flight compaction
+        executor write; the generation check drops a capture that lost the
+        race — installing the older state after the newer prune would leave
+        a snapshot whose missing mutations no segment holds anymore. Prune
+        ONLY on a successful install: a failed snapshot write (ENOSPC, EIO)
+        must keep the sealed segments, or the acknowledged mutations in
+        them would vanish on the next restore."""
+        with self._snap_lock:
+            if gen <= self._snap_installed:
+                return
+            if self._write_snapshot_state(state):
+                self._snap_installed = gen
+                if self.wal is not None:
+                    self.wal.prune(seq)
+
+    def _write_snapshot_state(self, state: dict) -> bool:
         try:
             tmp = self.store_path + ".tmp"
             with open(tmp, "wb") as f:
-                pickle.dump(self._durable_state(), f)
+                pickle.dump(state, f)
             os.replace(tmp, self.store_path)
+            return True
         except OSError:
             logger.exception("GCS snapshot write failed")
+            return False
 
-    async def _snapshot_loop(self):
+    async def _compaction_loop(self):
+        """Snapshot + WAL-truncate compaction (replaces the old lossy 1s
+        snapshot loop, whose inter-tick mutations died with the process).
+        Durability now comes from the WAL; this loop only bounds restart
+        replay time and reclaims log space. With the WAL disabled the
+        snapshot IS the durability plane again, so it keeps the historical
+        1s cadence instead of the compaction interval."""
+        snap_interval = (_config.gcs_snapshot_interval_s
+                         if self.wal is not None else 1.0)
+        last = time.monotonic()
         while True:
             await asyncio.sleep(1.0)
-            if not self._store_dirty:
+            now = time.monotonic()
+            over = (self.wal is not None
+                    and self.wal.size() >= _config.gcs_wal_max_bytes)
+            due = (self._store_dirty and now - last >= snap_interval)
+            if not (over or due):
                 continue
+            last = now
             self._store_dirty = False
-            # the dump can carry large runtime_env packages in the KV:
-            # off-loop so scheduling/heartbeat RPCs never stall behind it
-            await asyncio.get_event_loop().run_in_executor(
-                None, self._write_snapshot
-            )
+            # rotate + durable-table capture ON the loop (consistent
+            # tables; records landing after the rotate carry higher seqs
+            # and replay idempotently over this snapshot); the task-event
+            # and timeseries copy-outs take their own locks and run OFF
+            # the loop with the pickle + prune — the aggregator copy
+            # grows with retained history and would stall heartbeat and
+            # scheduling rpcs if done inline
+            self._snap_gen += 1
+            gen = self._snap_gen
+            seq = self.wal.rotate() if self.wal is not None else 0
+            state = self._snapshot_state(seq, include_heavy=False)
 
-    def _restore_store(self) -> None:
+            def write():
+                # slight skew vs the table capture is fine: both are
+                # soft state, replaced wholesale on the next compaction
+                self._snapshot_heavy(state)
+                self._install_snapshot(gen, state, seq)
+
+            await asyncio.get_event_loop().run_in_executor(None, write)
+            if _config.metrics_enabled:
+                from ray_tpu.util.metrics import Counter
+
+                Counter(
+                    "gcs_wal_compactions_total",
+                    "snapshot+truncate compactions of the GCS WAL",
+                ).inc(1.0)
+
+    def _restore_actor(self, aid: bytes, a: dict) -> None:
+        """(Re)build a restored detached actor PENDING; idempotent — WAL
+        replay over a snapshot-restored entry overwrites in place."""
+        info = ActorInfo(
+            actor_id=aid,
+            spec_blob=a["spec_blob"],
+            name=a["name"],
+            namespace=a.get("namespace", "default"),
+            detached=True,
+            max_restarts=a["max_restarts"],
+            restarts_left=a["restarts_left"],
+            resources=a["resources"],
+            pg_id=a["pg_id"],
+            bundle_index=a["bundle_index"],
+        )
+        info.restore_node_hint = a.get("node_id")
+        self.actors[aid] = info
+        if info.name:
+            self.named_actors[(info.namespace, info.name)] = aid
+
+    def _restore_store(self) -> int:
+        """Load the newest snapshot; returns the WAL sequence it covers
+        (0 = no/unreadable snapshot: replay the whole log)."""
         try:
             with open(self.store_path, "rb") as f:
                 state = pickle.load(f)
         except FileNotFoundError:
-            return
+            return 0
         except Exception:  # noqa: BLE001 - corrupt snapshot: start fresh
             logger.exception("GCS snapshot restore failed; starting fresh")
-            return
+            return 0
+        return self._restore_from_state(state)
+
+    def _restore_from_state(self, state: dict) -> int:
         self.kv = state.get("kv", {})
         self.functions = state.get("functions", {})
         self.job_counter = state.get("job_counter", 0)
         for pg_id, p in state.get("placement_groups", {}).items():
-            self.placement_groups[pg_id] = PlacementGroupInfo(
-                pg_id=pg_id, bundles=p["bundles"], strategy=p["strategy"],
-                detached=True, placement=p.get("placement"),
-                state="CREATED" if p.get("placement") else "PENDING",
-            )
+            self._apply_wal("pg_put", {"pg_id": pg_id, "entry": p})
         for aid, a in state.get("actors", {}).items():
-            info = ActorInfo(
-                actor_id=aid,
-                spec_blob=a["spec_blob"],
-                name=a["name"],
-                namespace=a["namespace"],
-                detached=True,
-                max_restarts=a["max_restarts"],
-                restarts_left=a["restarts_left"],
-                resources=a["resources"],
-                pg_id=a["pg_id"],
-                bundle_index=a["bundle_index"],
-            )
-            info.restore_node_hint = a.get("node_id")
-            self.actors[aid] = info
-        self.named_actors = dict(state.get("named_actors", {}))
-        n = len(self.actors)
+            self._restore_actor(aid, a)
+        self.named_actors.update(state.get("named_actors", {}))
+        self.channel_endpoints.update(state.get("channel_endpoints", {}))
+        self.metrics.update(state.get("metrics", {}))
+        self.metric_reports.update(state.get("metric_reports", {}))
+        self.timeseries.restore(state.get("timeseries", ()))
+        self.task_events.restore(state.get("task_events"))
+        self.node_wal_tails.update(state.get("node_wal_tails", {}))
         logger.info(
-            "GCS restored: %d kv, %d fns, %d detached actors",
-            len(self.kv), len(self.functions), n,
+            "GCS restored: %d kv, %d fns, %d detached actors, %d endpoints, "
+            "%d timeseries samples",
+            len(self.kv), len(self.functions), len(self.actors),
+            len(self.channel_endpoints), len(self.timeseries),
         )
-        # restored actors/PGs reschedule once nodes re-register
+        return int(state.get("wal_seq", 0))
+
+    def _schedule_restored(self) -> None:
+        """Restored actors/PGs reschedule once nodes re-register (called
+        after snapshot restore AND WAL replay, so a replayed actor_dead
+        never races a stale reschedule)."""
         for info in list(self.actors.values()):
-            self._call_later_held(1.0, self._retry_schedule, info)
+            if info.state != DEAD:
+                self._call_later_held(1.0, self._retry_schedule, info)
         for pg in list(self.placement_groups.values()):
             self._call_later_held(1.0, self._retry_place_pg, pg)
+        # whole-node forensics for nodes that died DURING the head outage:
+        # only _on_node_dead ingests shipped tails, and a node that never
+        # re-registers never gets declared dead "again" — so restored tails
+        # of missing nodes would sit forever and the dead workers' task
+        # timelines would never close. Give live raylets one health-check
+        # window to re-register, then ingest the tails of the ones that
+        # did not come back.
+        if self.node_wal_tails:
+            grace = max(
+                2.0,
+                _config.health_check_period_ms / 1000
+                * _config.health_check_failure_threshold,
+            )
+            self._call_later_held(grace, self._ingest_orphan_tails)
+
+    async def _ingest_orphan_tails(self) -> None:
+        for node_id in list(self.node_wal_tails):
+            if node_id not in self.nodes:
+                logger.warning(
+                    "node %s never re-registered after GCS restore; "
+                    "ingesting its shipped WAL tails", node_id,
+                )
+                self._ingest_shipped_wals(node_id)
 
     # ------------------------------------------------------------- pubsub
     async def publish(self, channel: str, payload):
@@ -415,11 +693,37 @@ class GcsServer:
     async def _on_node_dead(self, node: NodeInfo, reason: str):
         node.alive = False
         logger.warning("node %s dead: %s", node.node_id, reason)
+        # whole-node-loss forensics: the node's raylet died WITH its
+        # workers, so nobody will ever recover their task-event WALs from
+        # that host — ingest the tails it shipped here while alive, closing
+        # the dead workers' timelines (idempotent wal- source dedup)
+        self._ingest_shipped_wals(node.node_id)
         await self.publish("node", {"event": "dead", "node_id": node.node_id})
         # fail over actors on that node
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state in (ALIVE, PENDING):
                 await self._on_actor_failure(actor, f"node {node.node_id} died")
+
+    def _ingest_shipped_wals(self, node_id: str) -> int:
+        tails = self.node_wal_tails.pop(node_id, None)
+        if not tails:
+            return 0
+        n = 0
+        for name, events in tails.items():
+            # "wal-" source prefix arms the aggregator's replay dedup, so
+            # events the worker managed to flush before the node died (or
+            # that a same-host sweep recovers later) never double-count
+            self.task_events.ingest(
+                events, source=f"wal-ship-{node_id}-{name}"
+            )
+            n += len(events)
+        if n:
+            self._store_dirty = True
+            logger.warning(
+                "node %s died: closed its timelines with %d shipped "
+                "WAL-tail task events", node_id, n,
+            )
+        return n
 
     # ----------------------------------------------------------------- kv
     def handle_kv_put(self, conn, ns, key, value, overwrite=True):
@@ -427,6 +731,7 @@ class GcsServer:
         if not overwrite and k in self.kv:
             return False
         self.kv[k] = value
+        self._append_wal("kv_put", ns=ns, key=key, value=value)
         self._store_dirty = True
         return True
 
@@ -435,7 +740,10 @@ class GcsServer:
 
     def handle_kv_del(self, conn, ns, key):
         self._store_dirty = True
-        return self.kv.pop((ns, key), None) is not None
+        existed = self.kv.pop((ns, key), None) is not None
+        if existed:
+            self._append_wal("kv_del", ns=ns, key=key)
+        return existed
 
     def handle_kv_keys(self, conn, ns, prefix=""):
         return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
@@ -449,9 +757,12 @@ class GcsServer:
         tombstone a dead reader's endpoints and waiting writers fail fast
         typed instead of dialing a ghost."""
         self._bound_endpoint_registry()
-        self.channel_endpoints[channel_id] = {
-            "endpoint": endpoint, "owner": owner,
-        }
+        entry = {"endpoint": endpoint, "owner": owner}
+        self.channel_endpoints[channel_id] = entry
+        # durable: a writer resolving this endpoint AFTER a GCS restart
+        # (late materialize, long-lived compiled graph) must still find it
+        self._append_wal("ep_put", channel_id=channel_id, entry=dict(entry))
+        self._store_dirty = True
         ev = self._endpoint_events.pop(channel_id, None)
         if ev is not None:
             ev.set()
@@ -488,7 +799,9 @@ class GcsServer:
                     self._endpoint_events.pop(channel_id, None)
 
     def handle_remove_channel_endpoint(self, conn, channel_id: str):
-        self.channel_endpoints.pop(channel_id, None)
+        if self.channel_endpoints.pop(channel_id, None) is not None:
+            self._append_wal("ep_del", channel_id=channel_id)
+            self._store_dirty = True
         return True
 
     def _bound_endpoint_registry(self) -> None:
@@ -515,6 +828,8 @@ class GcsServer:
         dead channel. Kept as a tombstone in the bounded registry."""
         self._bound_endpoint_registry()
         self.channel_endpoints[channel_id] = {"closed": True, "owner": ""}
+        self._append_wal("ep_close", channel_id=channel_id)
+        self._store_dirty = True
         ev = self._endpoint_events.pop(channel_id, None)
         if ev is not None:
             ev.set()
@@ -533,11 +848,15 @@ class GcsServer:
                 if ev is not None:
                     ev.set()
                 n += 1
+        if n:
+            self._append_wal("ep_drop", owner=owner, reason=reason)
+            self._store_dirty = True
         return n
 
     # ---------------------------------------------------------- functions
     def handle_register_function(self, conn, fn_id, blob):
         self.functions[fn_id] = blob
+        self._append_wal("fn", fn_id=fn_id, blob=blob)
         self._store_dirty = True
         return True
 
@@ -545,9 +864,20 @@ class GcsServer:
         return self.functions.get(fn_id)
 
     # -------------------------------------------------------------- jobs
-    def handle_register_driver(self, conn, metadata=None):
-        self.job_counter += 1
+    def handle_register_driver(self, conn, metadata=None, job_id=None):
+        """Mint a job id — or, with ``job_id``, RE-register a driver that
+        reconnected to a restarted GCS: it keeps its identity (per-job
+        task retention, job-tagged events stay one job) and the counter
+        only moves forward so later fresh drivers never collide."""
         conn.is_driver = True
+        if job_id is not None:
+            self.job_counter = max(self.job_counter, int(job_id))
+            self._append_wal("job", value=self.job_counter)
+            self._store_dirty = True
+            return {"job_id": int(job_id)}
+        self.job_counter += 1
+        self._append_wal("job", value=self.job_counter)
+        self._store_dirty = True
         return {"job_id": self.job_counter}
 
     # ------------------------------------------------------------- actors
@@ -588,6 +918,12 @@ class GcsServer:
         )
         self.actors[actor_id] = info
         self._store_dirty = True
+        if detached:
+            # durable before the creation rpc is acknowledged: a detached
+            # actor the caller believes exists must survive a head crash
+            self._append_wal(
+                "actor_put", aid=actor_id, entry=self._actor_entry(info)
+            )
         if not detached:
             self._conn_owned_actors.setdefault(conn, set()).add(actor_id)
         await self._schedule_actor(info)
@@ -700,6 +1036,12 @@ class GcsServer:
         info.state = ALIVE
         info.address = address
         info.node_id = node_id
+        if info.detached:
+            # refresh the durable adoption hint (node placement +
+            # remaining restart budget) now that the actor is live here
+            self._append_wal(
+                "actor_put", aid=actor_id, entry=self._actor_entry(info)
+            )
         self._signal_actor_state(actor_id)
         await self.publish("actor", info.public())
         return True
@@ -725,6 +1067,8 @@ class GcsServer:
 
     async def _mark_actor_dead(self, info: ActorInfo, reason: str):
         self._store_dirty = True
+        if info.detached:
+            self._append_wal("actor_dead", aid=info.actor_id)
         info.state = DEAD
         self._signal_actor_state(info.actor_id)
         info.death_reason = reason
@@ -786,6 +1130,47 @@ class GcsServer:
             key = f"tasks_{state.lower()}"
             self.metrics[key] = self.metrics.get(key, 0) + 1
         return True
+
+    def handle_ship_wal_tail(self, conn, node_id: str, tails: Dict[str, list]):
+        """A raylet shipped its workers' CURRENT unflushed task-event WAL
+        tails (whole-node-loss forensics). Replace semantics per file: each
+        shipment is the complete tail, so re-ships after a worker flush
+        shrink the stored copy, and an empty list removes it. The tails sit
+        here un-ingested until the node dies uncleanly — live nodes deliver
+        the same events through their normal flush/recovery paths."""
+        store = self.node_wal_tails.setdefault(node_id, {})
+        for name, events in tails.items():
+            if events:
+                store[name] = events
+            else:
+                store.pop(name, None)
+        # bound a pathological node (worker churn with an unreachable
+        # flush path): oldest-file eviction
+        while len(store) > 256:
+            store.pop(next(iter(store)))
+        self._store_dirty = True
+        return True
+
+    async def handle_chaos_install(self, conn, plan_json: str,
+                                   log_path: str = ""):
+        """Driver pushed a chaos plan to ALREADY-RUNNING daemons
+        (chaos.activate): install it in this process and fan it out to
+        every live raylet. Returns how many daemon processes accepted."""
+        from ray_tpu.testing import chaos
+
+        n = 1 if chaos.install_from_push(plan_json, log_path) else 0
+        for node in list(self.nodes.values()):
+            if not node.alive or node.conn is None:
+                continue
+            try:
+                ok = await node.conn.call(
+                    "chaos_install", plan_json=plan_json,
+                    log_path=log_path, timeout=10,
+                )
+                n += 1 if ok else 0
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass
+        return n
 
     def handle_list_tasks(self, conn, limit=1000):
         """One row per task: latest state, ids hex-normalized."""
@@ -931,6 +1316,10 @@ class GcsServer:
         )
         self.placement_groups[pg_id] = info
         self._store_dirty = True
+        if detached:
+            self._append_wal("pg_put", pg_id=pg_id, entry={
+                "bundles": bundles, "strategy": strategy, "placement": None,
+            })
         if not detached:
             self._conn_owned_pgs.setdefault(conn, set()).add(pg_id)
         deadline = time.monotonic() + create_timeout
@@ -1013,6 +1402,11 @@ class GcsServer:
         info.placement = placement
         info.state = "CREATED"
         self._store_dirty = True
+        if info.detached:
+            self._append_wal("pg_put", pg_id=info.pg_id, entry={
+                "bundles": info.bundles, "strategy": info.strategy,
+                "placement": placement,
+            })
         await self.publish("pg", {"pg_id": info.pg_id, "state": "CREATED"})
         return True
 
@@ -1021,6 +1415,8 @@ class GcsServer:
         info = self.placement_groups.pop(pg_id, None)
         if info is None:
             return False
+        if info.detached:
+            self._append_wal("pg_del", pg_id=pg_id)
         if info.placement:
             for idx, node_id in enumerate(info.placement):
                 node = self.nodes.get(node_id)
@@ -1062,6 +1458,66 @@ class GcsServer:
             node = self.nodes[node_id]
             if node.alive and node.conn is conn:
                 await self._on_node_dead(node, "connection lost")
+
+
+def offline_head_state(store_path: str, last_records: int = 20) -> dict:
+    """Forensics on a dead cluster's store dir: decode snapshot + WAL
+    WITHOUT starting a GCS (``python -m ray_tpu.scripts head-state``).
+    Rebuilds the tables exactly like a restart would (snapshot, then
+    replay, torn tail tolerated) and returns a JSON-friendly summary."""
+    from ray_tpu.core.gcs import wal as wal_mod
+
+    srv = GcsServer(store_path=store_path)
+    snapshot_seq = srv._restore_store()
+    records = list(wal_mod.replay(store_path + ".wal", snapshot_seq))
+    for seq, op, data in records:
+        try:
+            srv._apply_wal(op, data)
+        except Exception:  # noqa: BLE001 - forensics: keep decoding
+            logger.exception("offline replay failed for %r seq %d", op, seq)
+    segs = wal_mod.list_segments(store_path + ".wal")
+    detached = [
+        {
+            "actor_id": aid.hex() if isinstance(aid, bytes) else str(aid),
+            "name": i.name,
+            "namespace": i.namespace,
+            "node_hint": getattr(i, "restore_node_hint", None) or i.node_id,
+            "restarts_left": i.restarts_left,
+        }
+        for aid, i in srv.actors.items()
+    ]
+    return {
+        "store_path": store_path,
+        "snapshot_present": os.path.exists(store_path),
+        "snapshot_wal_seq": snapshot_seq,
+        "wal_segments": [
+            {"first_seq": first, "path": p, "bytes": os.path.getsize(p)}
+            for first, p in segs
+        ],
+        "wal_records_replayed": len(records),
+        "last_wal_seq": records[-1][0] if records else snapshot_seq,
+        "job_counter": srv.job_counter,
+        "kv_keys": sorted(f"{ns}/{key}" for ns, key in srv.kv),
+        "num_functions": len(srv.functions),
+        "detached_actors": detached,
+        "named_actors": sorted(
+            f"{ns}/{name}" for ns, name in srv.named_actors
+        ),
+        "num_placement_groups": len(srv.placement_groups),
+        "num_channel_endpoints": len(srv.channel_endpoints),
+        "task_events": srv.task_events.stats(),
+        "timeseries_samples": len(srv.timeseries),
+        "node_wal_tails": {
+            node: sum(len(evs) for evs in tails.values())
+            for node, tails in srv.node_wal_tails.items()
+        },
+        "last_records": [
+            {"seq": seq, "op": op,
+             "keys": sorted(k for k in data if k not in ("value", "blob",
+                                                         "entry"))}
+            for seq, op, data in records[-max(0, last_records):]
+        ],
+    }
 
 
 def main():

@@ -198,6 +198,7 @@ class Raylet:
         self._bg.append(asyncio.create_task(self._metrics_flush_loop()))
         self._bg.append(asyncio.create_task(self._task_events_flush_loop()))
         self._bg.append(asyncio.create_task(self._orphan_wal_scan_loop()))
+        self._bg.append(asyncio.create_task(self._wal_ship_loop()))
         if _config.enable_worker_prestart:
             n = min(2, int(self.total.get("CPU")) or 1)
             for _ in range(n):
@@ -958,6 +959,98 @@ class Raylet:
                     os.unlink(path)
                 except OSError:
                     pass
+
+    async def _wal_ship_loop(self):
+        """Whole-node-loss forensics: periodically ship this node's
+        workers' UNFLUSHED task-event WAL tails to the GCS. The raylet's
+        own death-recovery path (_recover_worker_wal / the orphan sweep)
+        only runs while some raylet on this host survives — if the entire
+        node dies (power, OOM-kill of the whole tree, host loss in real
+        multi-host), those tmpfs files die with it. The GCS keeps the
+        latest shipped copy per (node, file), replace semantics, and
+        ingests it only when the node is declared dead — live nodes
+        deliver the same events through the normal flush plane, and the
+        wal- source dedup makes any overlap idempotent. Bounded: at most
+        ``task_events_wal_ship_max_bytes`` of tail per file per shipment,
+        batched into ONE notify per tick."""
+        from ray_tpu.core.object_store.shm_store import session_dir
+
+        if not _config.task_events_wal_enabled:
+            return
+        wal_dir = os.path.join(session_dir(self.session), "task_wal")
+        period = max(_config.task_events_wal_ship_interval_ms, 100) / 1000
+        m_shipped = None
+        prefix = f"wal-{self.node_id}-"
+        last_sig: Dict[str, tuple] = {}  # name -> (size, mtime) last shipped
+        shipped_to = None  # the GCS connection last_sig was shipped over
+        while True:
+            await asyncio.sleep(period)
+            conn = self.gcs
+            if conn is None or conn.closed:
+                continue  # reconnect loop will catch up next tick
+            if conn is not shipped_to:
+                # the reconnect loop swapped the connection: the restarted
+                # GCS restored tails from its last snapshot, which may
+                # predate everything shipped since — drop the dedup state
+                # so every live file re-ships even if its (size, mtime)
+                # never changes again
+                last_sig = {}
+                shipped_to = conn
+            try:
+                names = os.listdir(wal_dir)
+            except OSError:
+                continue
+            tails: Dict[str, list] = {}
+            sig_now: Dict[str, tuple] = {}
+            for name in names:
+                # ship only OUR workers' files: a peer raylet ships its own
+                if not name.startswith(prefix):
+                    continue
+                path = os.path.join(wal_dir, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                sig_now[name] = (st.st_size, st.st_mtime)
+                if last_sig.get(name) == sig_now[name]:
+                    continue  # unchanged since the last shipment
+                tails[name] = tracing.read_wal(
+                    path, max_bytes=_config.task_events_wal_ship_max_bytes
+                )
+            # files that vanished (flush truncated to nothing + unlink,
+            # recovery) retract their stored tail
+            for name in list(last_sig):
+                if name not in sig_now:
+                    tails[name] = []
+            if not tails:
+                continue
+            if self.gcs is None or self.gcs.closed:
+                continue  # reconnect loop will catch up next tick
+            try:
+                await self.gcs.notify(
+                    "ship_wal_tail", node_id=self.node_id, tails=tails,
+                )
+            except (rpc.RpcError, rpc.ConnectionLost):
+                continue  # nothing recorded as shipped: retry next tick
+            last_sig = sig_now
+            shipped = sum(len(v) for v in tails.values())
+            if shipped and _config.metrics_enabled:
+                if m_shipped is None:
+                    from ray_tpu.util import metrics as metrics_api
+
+                    m_shipped = metrics_api.Counter(
+                        "task_events_wal_shipped_total",
+                        "task events shipped to the GCS as node-loss WAL "
+                        "tails",
+                    )
+                m_shipped.inc(float(shipped))
+
+    def handle_chaos_install(self, conn, plan_json: str, log_path: str = ""):
+        """GCS fan-out of chaos.activate: arm the plan in this raylet (and,
+        via the exported env vars, in every worker spawned afterwards)."""
+        from ray_tpu.testing import chaos
+
+        return chaos.install_from_push(plan_json, log_path)
 
     # -------------------------------------------------------------- actors
     async def handle_create_actor_worker(self, conn, actor_id, spec_blob,

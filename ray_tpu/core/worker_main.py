@@ -263,7 +263,10 @@ class WorkerAgent(CoreWorker):
         user code — the client stopped waiting, so executing it would only
         steal worker time from requests that can still make their SLO.
         Returns the error reply to send, or None to proceed."""
-        deadline = getattr(spec, "deadline", None)
+        # first touch in this process: re-anchor the owner-minted deadline
+        # into the local clock domain (NTP-skew guard — a skewed receiver
+        # clamps instead of falsely shedding; see ts.effective_deadline)
+        deadline = ts.localize_deadline(spec)
         if deadline is None or time.time() < deadline:
             return None
         from ray_tpu.util.metrics import deadline_expired_counter

@@ -20,6 +20,42 @@ from typing import Any, Dict, Optional
 
 from ray_tpu.core import rpc
 
+def timeseries_to_json(samples) -> list:
+    """Pure converter behind ``/api/timeseries``: tag-tuple point keys
+    become ``[{"tags": {...}, "value": v}]`` lists, and each histogram's
+    DDSketch rides along JSON-safely (``{"tags", "z", "c"}`` rows; the
+    log-bucket indices stringify — readers int() them back), so
+    ``scripts metrics --dashboard`` computes the SAME ±1%-accurate
+    percentiles as a driver-connected reader instead of falling back to
+    exposition-bucket interpolation."""
+    out = []
+    for s in samples:
+        series = []
+        for x in s["series"]:
+            row = {
+                "name": x["name"],
+                "kind": x["kind"],
+                "boundaries": x.get("boundaries") or [],
+                "points": [
+                    {"tags": dict(tags), "value": val}
+                    for tags, val in x["points"].items()
+                ],
+            }
+            sks = x.get("sketches")
+            if sks:
+                row["sketches"] = [
+                    {
+                        "tags": dict(tags),
+                        "z": sk.get("z", 0),
+                        "c": {str(k): v for k, v in sk.get("c", {}).items()},
+                    }
+                    for tags, sk in sks.items()
+                ]
+            series.append(row)
+        out.append({"ts": s["ts"], "series": series})
+    return out
+
+
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
 <style>
@@ -212,24 +248,7 @@ class Dashboard:
             samples = await self._gcs_call(
                 "get_metrics_timeseries", limit=limit
             )
-            return [
-                {
-                    "ts": s["ts"],
-                    "series": [
-                        {
-                            "name": x["name"],
-                            "kind": x["kind"],
-                            "boundaries": x.get("boundaries") or [],
-                            "points": [
-                                {"tags": dict(tags), "value": val}
-                                for tags, val in x["points"].items()
-                            ],
-                        }
-                        for x in s["series"]
-                    ],
-                }
-                for s in samples
-            ]
+            return timeseries_to_json(samples)
         return None
 
     async def _handle(self, reader: asyncio.StreamReader,

@@ -82,6 +82,15 @@ class ObjectStoreFullError(RayTpuError):
     pass
 
 
+class GcsUnavailableError(RayTpuError):
+    """The head plane (GCS) stayed unreachable across the whole retry
+    window. With head-plane durability a restarted GCS re-answers on the
+    same address within ~seconds, so in-flight control-plane waiters
+    (``get_actor``, ``get_channel_endpoint``, function registration) retry
+    behind the standard backoff policy and raise THIS — never a raw
+    ``ConnectionLost`` — when the head genuinely did not come back."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

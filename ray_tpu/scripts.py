@@ -268,23 +268,34 @@ def samples_from_dashboard_json(data) -> list:
     """Convert ``/api/timeseries`` JSON (points as ``[{"tags", "value"}]``
     lists) back into the internal sample shape (points keyed by sorted tag
     tuples) that ``render_metrics_snapshot`` / ``util.metrics`` math
-    consume. Pure function — the HTTP-mode CLI and its tests share it."""
-    return [
-        {
-            "ts": s["ts"],
-            "series": [
-                {
-                    "name": x["name"],
-                    "kind": x.get("kind"),
-                    "boundaries": x.get("boundaries") or [],
-                    "points": {
-                        tuple(sorted(p.get("tags", {}).items())): p["value"]
-                        for p in x.get("points", [])
-                    },
-                }
-                for x in s.get("series", [])
-            ],
+    consume. Quantile sketches round-trip too (JSON stringified their
+    log-bucket indices; they int() back here), so dashboard-sourced
+    percentiles match driver-side sketch math instead of degrading to
+    exposition-bucket interpolation. Pure function — the HTTP-mode CLI and
+    its tests share it."""
+    def series(x):
+        row = {
+            "name": x["name"],
+            "kind": x.get("kind"),
+            "boundaries": x.get("boundaries") or [],
+            "points": {
+                tuple(sorted(p.get("tags", {}).items())): p["value"]
+                for p in x.get("points", [])
+            },
         }
+        sks = x.get("sketches")
+        if sks:
+            row["sketches"] = {
+                tuple(sorted(sk.get("tags", {}).items())): {
+                    "z": sk.get("z", 0),
+                    "c": {int(k): v for k, v in sk.get("c", {}).items()},
+                }
+                for sk in sks
+            }
+        return row
+
+    return [
+        {"ts": s["ts"], "series": [series(x) for x in s.get("series", [])]}
         for s in data
     ]
 
@@ -358,6 +369,51 @@ def cmd_lint(args) -> int:
               f"({sup} suppressed, {base} baselined, "
               f"{len(result.errors)} error(s))")
     return 0 if result.clean else 1
+
+
+def cmd_head_state(args) -> int:
+    """Offline forensics on a (possibly dead) cluster's GCS store dir:
+    decode the snapshot + WAL segments exactly like a restart would (torn
+    tail tolerated) and print what the head plane knew — no running GCS,
+    no driver connection."""
+    from ray_tpu.core.gcs.server import offline_head_state
+
+    store = args.store
+    if os.path.isdir(store):
+        store = os.path.join(store, "gcs_store.pkl")
+    state = offline_head_state(store, last_records=args.records)
+    if args.json:
+        print(json.dumps(state, indent=2, default=str))
+        return 0
+    print(f"store:               {state['store_path']}")
+    print(f"snapshot present:    {state['snapshot_present']} "
+          f"(covers WAL seq {state['snapshot_wal_seq']})")
+    segs = state["wal_segments"]
+    print(f"wal segments:        {len(segs)} "
+          f"({sum(s['bytes'] for s in segs)} bytes)")
+    print(f"wal records replayed: {state['wal_records_replayed']} "
+          f"(last seq {state['last_wal_seq']})")
+    print(f"job counter:         {state['job_counter']}")
+    print(f"kv keys:             {len(state['kv_keys'])}")
+    print(f"functions:           {state['num_functions']}")
+    print(f"detached actors:     {len(state['detached_actors'])}")
+    for a in state["detached_actors"]:
+        print(f"  - {a['name'] or a['actor_id'][:12]} "
+              f"(ns={a['namespace']}, node_hint={a['node_hint']})")
+    print(f"named actors:        {', '.join(state['named_actors']) or '-'}")
+    print(f"placement groups:    {state['num_placement_groups']}")
+    print(f"channel endpoints:   {state['num_channel_endpoints']}")
+    te = state["task_events"]
+    print(f"task events:         {te.get('task_events_tasks', 0)} tasks, "
+          f"{state['timeseries_samples']} metric samples")
+    if state["node_wal_tails"]:
+        print("shipped WAL tails:   " + ", ".join(
+            f"{n}={c} events" for n, c in state["node_wal_tails"].items()))
+    if state["last_records"]:
+        print("last WAL records:")
+        for r in state["last_records"]:
+            print(f"  seq {r['seq']:>8d}  {r['op']:<12s} {r['keys']}")
+    return 0
 
 
 def cmd_timeline(args) -> int:
@@ -459,6 +515,17 @@ def main(argv=None) -> int:
                    help="regenerate the README chaos-point table from "
                         "chaos.REGISTERED_POINTS before linting")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser(
+        "head-state", help="offline dump of a GCS store dir "
+        "(snapshot + WAL) — forensics on a dead cluster")
+    p.add_argument("--store", required=True,
+                   help="gcs_store.pkl path, or the session dir holding it")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--records", type=int, default=20,
+                   help="how many trailing WAL records to show")
+    p.set_defaults(fn=cmd_head_state)
 
     p = sub.add_parser("timeline", help="export Chrome-trace task timeline")
     p.add_argument("--address")

@@ -50,6 +50,14 @@ def _replica_key(actor) -> bytes:
 REPLICA_STARTUP_GRACE_S = 60.0
 
 
+# durable declarative state: the deployment targets checkpoint into the GCS
+# KV under this namespace (which rides the head-plane WAL), so a controller
+# lost with its node is rebuilt WITH its deployments by the next
+# serve.start() instead of coming back empty
+CHECKPOINT_NS = "serve"
+CHECKPOINT_KEY = "deployments"
+
+
 class ServeController:
     def __init__(self):
         self._deployments: Dict[str, Any] = {}     # name → Deployment
@@ -59,6 +67,15 @@ class ServeController:
         self._circuit_states: Dict[str, Dict[str, str]] = {}
         self._version = 0
         self._lock = _san.make_lock("serve.controller.state")
+        # serializes compute-targets + checkpoint save + in-memory commit:
+        # concurrent deploy() handler threads would otherwise each build a
+        # target list missing the other's deployment and the LAST kv_put
+        # to land would durably drop an already-acknowledged deploy (held
+        # across the blocking kv call — deploys are rare and correctness
+        # beats latency here; _lock alone can't cover it, the kv call must
+        # not run under the hot routing-table lock)
+        self._ckpt_lock = _san.make_lock("serve.controller.checkpoint")
+        self._restore_checkpoint()
         # serializes whole reconcile passes: deploy() calls _reconcile from
         # handler threads while the ticker thread runs it too — without
         # mutual exclusion both see len(actors) < target during the (slow,
@@ -71,23 +88,108 @@ class ServeController:
         )
         self._thread.start()
 
+    # ---------------------------------------------------------- durability
+    def _kv_call(self, method: str, **kw):
+        """Best-effort GCS KV access (the durable head store). Local mode
+        has no durable head — checkpointing degrades to a no-op there."""
+        from ray_tpu.api import _global_worker
+
+        core = getattr(_global_worker().backend, "core", None)
+        if core is None:
+            return None
+        return core.io.run(
+            core._gcs_call_retrying(method, **kw), timeout=60
+        )
+
+    def _save_checkpoint(self, targets: list) -> None:
+        """Persist the declarative targets. Runs after deploy/delete, i.e.
+        before those calls return — the acknowledged target state is in the
+        GCS WAL (kv_put) before the caller sees success. Raises on failure:
+        acking a deploy whose checkpoint never landed would silently roll
+        the fleet back to the PREVIOUS target after a controller loss, so
+        the caller must see the error (the kv call already rode out the
+        retry/backoff window) and retry the deploy itself. Runs BEFORE the
+        in-memory commit so a failed save leaves the live fleet matching
+        the durable target state."""
+        import cloudpickle
+
+        self._kv_call(
+            "kv_put", ns=CHECKPOINT_NS, key=CHECKPOINT_KEY,
+            value=cloudpickle.dumps(targets),
+        )
+
+    def _restore_checkpoint(self) -> None:
+        """A fresh controller adopts the checkpointed deployments (empty on
+        first boot): after a whole-node loss killed the controller AND its
+        replicas, serve.start() + this restore rebuilds the fleet to the
+        last acknowledged target state; the reconcile ticker starts the
+        replicas."""
+        import cloudpickle
+
+        try:
+            blob = self._kv_call(
+                "kv_get", ns=CHECKPOINT_NS, key=CHECKPOINT_KEY
+            )
+        except Exception:  # noqa: BLE001 - head unreachable: start empty
+            logger.exception("serve checkpoint restore failed")
+            return
+        if not blob:
+            return
+        try:
+            deployments = cloudpickle.loads(blob)
+        except Exception:  # noqa: BLE001 - corrupt checkpoint: start empty
+            logger.exception("serve checkpoint decode failed")
+            return
+        with self._lock:
+            for dep in deployments:
+                self._deployments[dep.name] = dep
+                rs = self._replicas.setdefault(dep.name, _ReplicaSet())
+                rs.target = (
+                    dep.autoscaling_config.min_replicas
+                    if dep.autoscaling_config else dep.num_replicas
+                )
+        if deployments:
+            logger.warning(
+                "serve controller restored %d deployment target(s) from "
+                "the durable checkpoint", len(deployments),
+            )
+
     # ------------------------------------------------------------ target API
     def deploy(self, deployment) -> bool:
-        with self._lock:
-            self._deployments[deployment.name] = deployment
-            rs = self._replicas.setdefault(deployment.name, _ReplicaSet())
-            rs.target = (
-                deployment.autoscaling_config.min_replicas
-                if deployment.autoscaling_config else deployment.num_replicas
-            )
+        with self._ckpt_lock:
+            if self._stop.is_set():
+                # a deploy that was blocked on the lock behind shutdown()
+                # must not re-persist targets after the checkpoint clear
+                raise RuntimeError("serve controller is shut down")
+            with self._lock:
+                targets = [d for d in self._deployments.values()
+                           if d.name != deployment.name] + [deployment]
+            self._save_checkpoint(targets)  # durable ack BEFORE the commit
+            with self._lock:
+                self._deployments[deployment.name] = deployment
+                rs = self._replicas.setdefault(
+                    deployment.name, _ReplicaSet()
+                )
+                rs.target = (
+                    deployment.autoscaling_config.min_replicas
+                    if deployment.autoscaling_config
+                    else deployment.num_replicas
+                )
         self._reconcile()
         return True
 
     def delete_deployment(self, name: str) -> bool:
-        with self._lock:
-            self._deployments.pop(name, None)
-            rs = self._replicas.pop(name, None)
-            self._circuit_states.pop(name, None)
+        with self._ckpt_lock:
+            if self._stop.is_set():
+                raise RuntimeError("serve controller is shut down")
+            with self._lock:
+                targets = [d for d in self._deployments.values()
+                           if d.name != name]
+            self._save_checkpoint(targets)  # durable ack BEFORE the commit
+            with self._lock:
+                self._deployments.pop(name, None)
+                rs = self._replicas.pop(name, None)
+                self._circuit_states.pop(name, None)
         if rs:
             self._stop_replicas(rs.actors)
         self._bump()
@@ -196,9 +298,23 @@ class ServeController:
 
     def shutdown(self) -> bool:
         self._stop.set()
+        with self._lock:
+            self._deployments.clear()
         for rs in self._replicas.values():
             self._stop_replicas(rs.actors)
         self._replicas.clear()
+        # an EXPLICIT shutdown retires the durable targets too — only an
+        # unclean controller loss should be resurrected by the checkpoint.
+        # _stop is set BEFORE taking _ckpt_lock, so a deploy that was
+        # blocked on the lock sees it after the clear and refuses instead
+        # of re-persisting its targets
+        try:
+            with self._ckpt_lock:
+                self._kv_call(
+                    "kv_del", ns=CHECKPOINT_NS, key=CHECKPOINT_KEY
+                )
+        except Exception:  # noqa: BLE001 - head already gone at teardown
+            pass
         return True
 
     # --------------------------------------------------------- reconciliation

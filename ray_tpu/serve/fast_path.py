@@ -12,7 +12,8 @@ for steady-state unary serve traffic:
   one-node graph over the replica's ``handle_request_fastpath`` entry point
   in the background (traffic keeps flowing on the slow path meanwhile);
 - once warmed, ``Router.assign_request`` dispatches unary requests by
-  writing ``(deadline, trace_id, args, kwargs)`` into the channel —
+  writing ``(deadline, minted_wall, minted_mono, trace_id, args, kwargs)``
+  into the channel —
   admission, circuit breaking and deadline minting already happened at the
   router, the replica re-enters the deadline/trace context and sheds
   expired work typed, and a per-pair drainer thread fulfills the caller's
@@ -214,8 +215,16 @@ class FastPathPool:
         # execute OUTSIDE the pool lock (it takes the dag's exec lock and
         # may probe the control plane); a demote racing us puts _STOP ahead
         # of this item, and the drainer's residual sweep still resolves it
+        # deadline clock-skew guard: the channel carries no TaskSpec, so
+        # the owner-minted (wall, mono) pair rides the payload — a replica
+        # on a skew-ahead host localizes instead of falsely shedding
+        minted_wall = time.time() if deadline is not None else None
+        minted_mono = time.monotonic() if deadline is not None else None
         try:
-            ref = dag.execute((deadline, trace_id, args, kwargs), timeout=0)
+            ref = dag.execute(
+                (deadline, minted_wall, minted_mono, trace_id, args, kwargs),
+                timeout=0,
+            )
         except ChannelTimeoutError:
             return False  # channel full: overflow rides the slow path
         except Exception as e:  # noqa: BLE001 - dead loop/severed/torn

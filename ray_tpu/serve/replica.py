@@ -299,15 +299,22 @@ class ServeReplica:
         dispatches steady-state unary requests through a compiled channel
         bound to this method instead of per-request task submission.
 
-        ``request`` is ``(deadline, trace_id, args, kwargs)``: the channel
-        carries no TaskSpec, so the deadline and trace id ride the payload
-        and re-enter the worker's task context here — nested deployment
-        calls inherit them exactly like on the routed path, and expired
-        requests shed typed BEFORE user code runs (PR-10 semantics)."""
+        ``request`` is ``(deadline, minted_wall, minted_mono, trace_id,
+        args, kwargs)``: the channel carries no TaskSpec, so the deadline
+        and trace id ride the payload and re-enter the worker's task
+        context here — nested deployment calls inherit them exactly like
+        on the routed path, and expired requests shed typed BEFORE user
+        code runs (PR-10 semantics). The owner-minted (wall, mono) pair
+        localizes the deadline into THIS host's clock domain first, so a
+        cross-host NTP skew beyond deadline_skew_tolerance_s clamps
+        instead of falsely shedding steady-state fast-path traffic —
+        same guard as the TaskSpec plane."""
         from ray_tpu import exceptions as exc
         from ray_tpu import tracing
+        from ray_tpu.core.task_spec import effective_deadline
 
-        deadline, trace_id, args, kwargs = request
+        deadline, minted_wall, minted_mono, trace_id, args, kwargs = request
+        deadline = effective_deadline(deadline, minted_wall, minted_mono)
         if deadline is not None and time.time() >= deadline:
             m = self._m()
             if m is not None:

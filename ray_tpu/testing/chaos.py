@@ -39,6 +39,9 @@ point                 where it fires
                       delayed (``slow_replica``): deterministic
                       slow/degraded-replica injection driving the serve
                       circuit breaker
+``gcs.wal``           ``core/gcs/wal.py`` append — the GCS hard-exits
+                      right after the Nth durable WAL record lands
+                      (mutation durable, reply unsent; no pre-exit flush)
 ====================  ======================================================
 
 Usage (context-manager API)::
@@ -50,10 +53,13 @@ Usage (context-manager API)::
         ...                        # injections fire deterministically
     plan.events()                  # every injection, cluster-wide
 
-Activation propagates two ways: in-process via a module global (local mode,
-the driver), and through ``RAY_TPU_CHAOS_PLAN`` (JSON) in the environment so
-cluster daemons and workers spawned *inside* the ``with`` block pick the plan
-up at startup. Every firing appends a JSON line to ``RAY_TPU_CHAOS_LOG``
+Activation propagates three ways: in-process via a module global (local
+mode, the driver); through ``RAY_TPU_CHAOS_PLAN`` (JSON) in the environment
+so cluster daemons and workers spawned *inside* the ``with`` block pick the
+plan up at startup; and — for daemons already running before the plan
+existed — :func:`activate` pushes the plan spec over rpc to the live GCS,
+which fans it out to every registered raylet (``chaos_install``). Every
+firing appends a JSON line to ``RAY_TPU_CHAOS_LOG``
 (shared across processes; O_APPEND) and logs a ``CHAOS`` warning, so a run
 is auditable and replayable from the seed.
 """
@@ -137,6 +143,14 @@ REGISTERED_POINTS: Dict[str, Dict[str, Any]] = {
         "where": "serve-replica request entry (unary + streaming): "
                  "matching calls are delayed — deterministic slow-replica "
                  "injection driving the circuit breaker",
+    },
+    "gcs.wal": {
+        "module": "ray_tpu/core/gcs/wal.py",
+        "builders": ["kill_gcs_at_wal"],
+        "where": "GCS write-ahead-log append: the process is SIGKILL-hard "
+                 "exited right after the Nth durable record lands — an "
+                 "arbitrary-offset crash with the mutation durable but its "
+                 "reply unsent (no pre-exit snapshot flush exists)",
     },
 }
 
@@ -243,6 +257,14 @@ class ChaosPlan:
         handles (after the handler mutated state, before the reply — the
         caller sees a lost connection). The test harness restarts it."""
         return self._rule("rpc.handle", "exit", match=on_call, nth=nth)
+
+    def kill_gcs_at_wal(self, nth: int = 1, match: str = "") -> "ChaosPlan":
+        """Hard-exit the GCS right after the Nth write-ahead-log record
+        whose op name contains ``match`` (empty = any durable mutation)
+        lands on disk. The record IS durable, its RPC reply is NOT sent —
+        the acknowledged-mutation audit window at an arbitrary WAL offset.
+        There is no pre-exit snapshot flush: the kill is a real kill."""
+        return self._rule("gcs.wal", "exit", match=match, nth=nth)
 
     # -------------------------------------------------------- serialization
     def to_json(self) -> str:
@@ -373,13 +395,38 @@ class _Runtime:
 
 _active: Optional[_Runtime] = None
 _env_checked = False
-_exit_callback: Optional[Callable[[], None]] = None
 _local_actor_killer: Optional[Callable[[str], bool]] = None
 
 
 def install(cplan: ChaosPlan) -> None:
     global _active
     _active = _Runtime(cplan)
+
+
+def install_from_push(plan_json: str, log_path: str = "") -> bool:
+    """Receiver side of :func:`activate`/:func:`deactivate`: a daemon got a
+    plan over rpc. Exports the env vars FIRST (the runtime reads
+    ``RAY_TPU_CHAOS_LOG`` at construction, and processes this daemon spawns
+    later — raylet workers — inherit the plan), then installs. An EMPTY
+    ``plan_json`` is a deactivation push: clears the exported env vars (so
+    nothing spawned later re-arms) and disarms the runtime."""
+    if not plan_json:
+        os.environ.pop(ENV_PLAN, None)
+        os.environ.pop(ENV_LOG, None)
+        uninstall()
+        logger.warning("chaos plan cleared via rpc push")
+        return True
+    try:
+        p = ChaosPlan.from_json(plan_json)
+    except Exception:  # noqa: BLE001 - malformed push must not kill daemon
+        logger.exception("invalid chaos_install payload ignored")
+        return False
+    os.environ[ENV_PLAN] = plan_json
+    if log_path:
+        os.environ[ENV_LOG] = log_path
+    install(p)
+    logger.warning("chaos plan installed via rpc push: %s", plan_json)
+    return True
 
 
 def uninstall() -> None:
@@ -416,23 +463,72 @@ def fire(point: str, key: str = "") -> Optional[Dict[str, Any]]:
 
 
 # ------------------------------------------------------------ action helpers
-def set_exit_callback(cb: Optional[Callable[[], None]]) -> None:
-    """Register a pre-exit hook for the ``exit`` action (the GCS registers
-    its synchronous snapshot write here, so a chaos crash is a crash *after*
-    durability — the same window the old sleep-and-kill tests approximated)."""
-    global _exit_callback
-    _exit_callback = cb
-
-
 def perform_exit(reason: str = "") -> None:
-    """Kill this process mid-call (``exit`` action)."""
+    """Kill this process mid-call (``exit`` action). No pre-exit hook
+    exists: an injected crash must be indistinguishable from a real one
+    (the GCS used to flush its snapshot here, which made every chaos kill
+    land exactly at a durability boundary and left the crash-consistency
+    window untested — retired with the head-plane WAL)."""
     logger.warning("CHAOS: exiting process (%s)", reason)
-    cb = _exit_callback
+    os._exit(1)
+
+
+def activate(cplan: ChaosPlan, log_path: Optional[str] = None) -> int:
+    """Arm ``cplan`` on the driver AND push it to every *already-running*
+    cluster daemon (GCS + raylets) over rpc.
+
+    The context-manager path only reaches processes spawned inside the
+    ``with`` block (env-var inheritance); daemons started earlier never see
+    the plan. ``activate`` closes that gap: the driver installs the plan
+    locally, exports the env vars (so processes spawned later still
+    inherit), then calls the GCS's ``chaos_install`` handler, which installs
+    it in the GCS process and fans it out to every live raylet — raylets
+    additionally export the env vars so workers THEY spawn later inherit
+    too. Returns the number of daemon processes that accepted the plan
+    (the driver itself not counted). Safe with no cluster up (returns 0)."""
+    log_path = log_path or os.environ.get(ENV_LOG) or os.path.join(
+        "/tmp", f"ray_tpu_chaos_{os.getpid()}_{uuid.uuid4().hex[:6]}.jsonl"
+    )
+    cplan._log_path = log_path
+    os.environ[ENV_PLAN] = cplan.to_json()
+    os.environ[ENV_LOG] = log_path
+    install(cplan)
+    return _push_to_daemons(cplan.to_json(), log_path)
+
+
+def deactivate() -> int:
+    """Counterpart of :func:`activate`: disarm the plan on the driver —
+    restoring a chaos-free environment for anything spawned later — AND
+    push the deactivation to every already-running daemon (an armed plan
+    left behind would keep firing in unrelated later work on a reused
+    cluster). Returns the number of daemon processes that cleared it
+    (driver not counted). Safe with no cluster up / nothing armed."""
+    os.environ.pop(ENV_PLAN, None)
+    os.environ.pop(ENV_LOG, None)
+    uninstall()
+    return _push_to_daemons("", "")
+
+
+def _push_to_daemons(plan_json: str, log_path: str) -> int:
+    """Hand a plan (or the empty deactivation payload) to the GCS, which
+    fans it out to every live raylet; returns daemons reached."""
     try:
-        if cb is not None:
-            cb()
-    finally:
-        os._exit(1)
+        from ray_tpu.api import _global_worker
+
+        worker = _global_worker()
+        core = getattr(getattr(worker, "backend", None), "core", None)
+    except Exception:  # noqa: BLE001 - not initialized / local mode
+        return 0
+    if core is None or core.gcs is None:
+        return 0
+    try:
+        n = core.io.run(core.gcs.call(
+            "chaos_install", plan_json=plan_json, log_path=log_path,
+            timeout=30,
+        ), timeout=60)
+        return int(n or 0)
+    except Exception:  # noqa: BLE001 - GCS down: env/local state stands
+        return 0
 
 
 def set_local_actor_killer(fn: Optional[Callable[[str], bool]]) -> None:

@@ -199,6 +199,47 @@ class TaskEventAggregator:
                         del self._job_tasks[job]
             self.evicted_tasks += 1
 
+    # ------------------------------------------------- snapshot (durability)
+    def dump(self) -> dict:
+        """Copy-out of the whole aggregation state for the GCS snapshot
+        (head-plane durability): a restarted GCS keeps per-job history and
+        closed timelines instead of starting blind. Event dicts are never
+        mutated after ingest, so per-record shallow copies suffice."""
+        with self._lock:
+            return {
+                "tasks": [
+                    (tid, {**rec, "events": list(rec["events"])})
+                    for tid, rec in self._tasks.items()
+                ],
+                "profile": list(self._profile),
+                "dropped_at_source": dict(self._dropped_at_source),
+                "evicted_tasks": self.evicted_tasks,
+                "evicted_per_job": dict(self.evicted_per_job),
+                "truncated_events": self.truncated_events,
+            }
+
+    def restore(self, state: Optional[dict]) -> None:
+        """Load a dump() (restart restore). Replaces current state; the
+        per-job retention index is rebuilt from the records."""
+        if not state:
+            return
+        with self._lock:
+            self._tasks.clear()
+            self._job_tasks.clear()
+            for tid, rec in state.get("tasks", []):
+                self._tasks[tid] = rec
+                job = rec.get("job_id")
+                if job is not None:
+                    self._job_tasks.setdefault(job, OrderedDict())[tid] = None
+            self._profile.clear()
+            self._profile.extend(state.get("profile", ()))
+            self._dropped_at_source = dict(
+                state.get("dropped_at_source", {})
+            )
+            self.evicted_tasks = state.get("evicted_tasks", 0)
+            self.evicted_per_job = dict(state.get("evicted_per_job", {}))
+            self.truncated_events = state.get("truncated_events", 0)
+
     # --------------------------------------------------------------- queries
     @staticmethod
     def _latest(rec: dict) -> dict:

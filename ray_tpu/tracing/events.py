@@ -442,15 +442,25 @@ async def flush_task_events_loop(buf: TaskEventBuffer, get_conn,
                 buf.note_dropped(len(events))
 
 
-def read_wal(path: str) -> List[dict]:
+def read_wal(path: str, max_bytes: Optional[int] = None) -> List[dict]:
     """Parse a worker's WAL file (JSON lines). Tolerates the torn final
     line a SIGKILL mid-write leaves behind; returns [] for a missing or
-    empty file."""
+    empty file. With ``max_bytes``, only the file's final ``max_bytes``
+    are decoded (the first, possibly mid-line, row is dropped) — the
+    bounded read behind raylet→GCS WAL-tail shipping."""
     import json
 
     out: List[dict] = []
     try:
         with open(path, "rb") as f:
+            if max_bytes is not None:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size > max_bytes:
+                    f.seek(size - max_bytes)
+                    f.readline()  # drop the partial first line
+                else:
+                    f.seek(0)
             for line in f:
                 try:
                     e = json.loads(line)

@@ -174,6 +174,16 @@ KNOWN_METRICS: Dict[str, str] = {
     "rpc_oob_bytes": "bytes sent out-of-band",
     "rpc_flushes": "outbox gather-writes",
     "rpc_frames_recv": "frames read from the wire",
+    # head-plane durability (core/gcs/wal.py + reconnect planes)
+    "gcs_wal_records_total": "durable-table mutations appended to the GCS "
+                             "WAL",
+    "gcs_wal_bytes_total": "bytes appended to the GCS WAL",
+    "gcs_wal_compactions_total": "snapshot+truncate compactions of the GCS "
+                                 "WAL",
+    "gcs_wal_replayed_total": "WAL records replayed on GCS restore",
+    "gcs_reconnects_total": "successful re-dials of a restarted GCS",
+    "task_events_wal_shipped_total": "task events shipped to the GCS as "
+                                     "node-loss WAL tails",
     # dev-mode runtime sanitizers (analysis/sanitizers.py)
     "sanitizer_violations_total": "sanitizer violations by kind",
 }
@@ -473,6 +483,18 @@ class MetricsTimeSeries:
         with self._lock:
             self._ring.append({"ts": ts or time.time(),
                                "series": series_list})
+
+    def dump(self) -> List[dict]:
+        """Copy-out for the GCS durability snapshot: a restarted head keeps
+        its metric history instead of an empty ring (samples are replaced
+        wholesale by ``sample()``, so shallow copies are safe)."""
+        with self._lock:
+            return list(self._ring)
+
+    def restore(self, samples: Sequence[dict]) -> None:
+        with self._lock:
+            for s in samples:
+                self._ring.append(s)
 
     def query(self, names: Optional[Sequence[str]] = None,
               limit: Optional[int] = None) -> List[dict]:

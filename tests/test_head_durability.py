@@ -1,0 +1,771 @@
+"""Head-plane durability: GCS WAL + full-table snapshots, restart-and-
+reattach, and whole-node-loss forensics.
+
+Acceptance (ISSUE 14): a chaos-injected GCS SIGKILL at an arbitrary WAL
+offset — no pre-exit snapshot flush — loses zero acknowledged durable-table
+mutations after restart, a serve deployment under load keeps serving across
+the restart with only typed errors, and a SIGKILLed *node*'s shipped WAL
+tails still close its workers' timelines.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+
+# --------------------------------------------------------------------------
+# units: WAL codec, torn tail, compaction, offline forensics
+# --------------------------------------------------------------------------
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    from ray_tpu.core.gcs import wal as wal_mod
+
+    base = str(tmp_path / "gcs_store.pkl.wal")
+    w = wal_mod.GcsWal(base)
+    w.open(0)
+    for i in range(10):
+        w.append("kv_put", {"ns": "t", "key": f"k{i}", "value": b"v" * i})
+    w.close()
+    recs = list(wal_mod.replay(base, 0))
+    assert [seq for seq, _, _ in recs] == list(range(1, 11))
+    assert all(op == "kv_put" for _, op, _ in recs)
+    assert recs[3][2] == {"ns": "t", "key": "k3", "value": b"vvv"}
+    # replay honors after_seq (snapshot coverage)
+    assert [seq for seq, _, _ in wal_mod.replay(base, 7)] == [8, 9, 10]
+    # a fresh writer resumes the sequence in the existing segment
+    w2 = wal_mod.GcsWal(base)
+    w2.open(10)
+    w2.append("kv_del", {"ns": "t", "key": "k0"})
+    w2.close()
+    assert list(wal_mod.replay(base, 10))[0][:2] == (11, "kv_del")
+
+
+def test_wal_torn_tail_tolerated(tmp_path):
+    """A SIGKILL mid-append leaves a short or CRC-failing final record; the
+    reader keeps the intact prefix and drops only the torn tail."""
+    from ray_tpu.core.gcs import wal as wal_mod
+
+    base = str(tmp_path / "s.wal")
+    w = wal_mod.GcsWal(base)
+    w.open(0)
+    for i in range(5):
+        w.append("kv_put", {"ns": "n", "key": str(i), "value": b"x"})
+    w.close()
+    (_, path), = wal_mod.list_segments(base)
+    intact = os.path.getsize(path)
+    # garbage appended after the last record (bad CRC): dropped
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefgarbage")
+    assert len(wal_mod.read_segment(path)) == 5
+    # record torn mid-payload: only that record is lost
+    with open(path, "r+b") as f:
+        f.truncate(intact - 3)
+    assert len(wal_mod.read_segment(path)) == 4
+    # torn mid-header
+    with open(path, "r+b") as f:
+        f.truncate(2)
+    assert wal_mod.read_segment(path) == []
+
+
+def test_wal_open_truncates_torn_tail_before_appending(tmp_path):
+    """Crash mid-append, restart, append more: the torn tail must be
+    truncated at open, or the post-restart records would sit BEHIND
+    garbage and be invisible to every future replay."""
+    from ray_tpu.core.gcs import wal as wal_mod
+
+    base = str(tmp_path / "s.wal")
+    w = wal_mod.GcsWal(base)
+    w.open(0)
+    for i in range(3):
+        w.append("kv_put", {"ns": "n", "key": str(i), "value": b"x"})
+    w.close()
+    (_, path), = wal_mod.list_segments(base)
+    with open(path, "r+b") as f:  # SIGKILL mid-record
+        f.truncate(os.path.getsize(path) - 2)
+    w2 = wal_mod.GcsWal(base)
+    w2.open(2)  # restart replayed the 2 intact records
+    w2.append("kv_put", {"ns": "n", "key": "post-crash", "value": b"y"})
+    w2.close()
+    recs = list(wal_mod.replay(base, 0))
+    assert [r[0] for r in recs] == [1, 2, 3]
+    assert recs[-1][2]["key"] == "post-crash"
+
+
+def test_wal_compaction_rotate_prune(tmp_path):
+    from ray_tpu.core.gcs import wal as wal_mod
+
+    base = str(tmp_path / "s.wal")
+    w = wal_mod.GcsWal(base)
+    w.open(0)
+    for i in range(6):
+        w.append("kv_put", {"ns": "n", "key": str(i), "value": b"x"})
+    sealed = w.rotate()
+    assert sealed == 6
+    w.append("kv_put", {"ns": "n", "key": "post", "value": b"y"})
+    assert len(wal_mod.list_segments(base)) == 2
+    # crash window: BOTH segments replay before the prune; seq filtering
+    # makes the sealed one a no-op against a snapshot covering seq 6
+    assert [seq for seq, _, _ in wal_mod.replay(base, sealed)] == [7]
+    assert len(list(wal_mod.replay(base, 0))) == 7
+    assert w.prune(sealed) == 1
+    assert len(wal_mod.list_segments(base)) == 1
+    assert [seq for seq, _, _ in wal_mod.replay(base, 0)] == [7]
+    w.close()
+
+
+def _mkconn():
+    return types.SimpleNamespace()
+
+
+def test_gcs_restore_snapshot_plus_wal(tmp_path):
+    """In-process restart cycle: acknowledged mutations — including ones
+    NEVER captured by any snapshot — survive via WAL replay; snapshot soft
+    state (metrics ring, task events, shipped tails) restores; a dead
+    node's shipped WAL tails close its timelines."""
+    from ray_tpu.core.gcs.server import GcsServer
+
+    store = str(tmp_path / "gcs_store.pkl")
+
+    async def run():
+        conn = _mkconn()
+        g = GcsServer(store_path=store)
+        await g.start()
+        g.handle_kv_put(conn, "ns", "a", b"1")
+        g.handle_register_function(conn, b"fid", b"blob")
+        assert g.handle_register_driver(conn)["job_id"] == 1
+        # idempotent re-register (driver reconnect): same id, no new mint
+        assert g.handle_register_driver(conn, job_id=1)["job_id"] == 1
+        assert g.job_counter == 1
+        g.handle_register_channel_endpoint(
+            conn, "chan1", {"host": "h", "port": 9, "node": "n"}, owner="n:1"
+        )
+        # unclean death: close the socket only — NO snapshot write
+        await g.server.close()
+        g.wal.close()
+
+        g2 = GcsServer(store_path=store)
+        await g2.start()
+        assert g2.kv[("ns", "a")] == b"1"
+        assert g2.functions[b"fid"] == b"blob"
+        assert g2.job_counter == 1
+        assert g2.channel_endpoints["chan1"]["endpoint"]["port"] == 9
+
+        # snapshot carries the soft state; later WAL records layer on top
+        g2.handle_ship_wal_tail(conn, "nodeX", {"wal-nodeX-7.jsonl": [
+            {"task_id": "t1", "state": "RUNNING", "ts": 1.0, "name": "f"},
+        ]})
+        g2.timeseries.sample([{"name": "x", "kind": "counter",
+                               "boundaries": [], "points": {(): 1.0}}])
+        g2._write_snapshot()
+        g2.handle_kv_put(conn, "ns", "c", b"3")
+        await g2.server.close()
+        g2.wal.close()
+
+        g3 = GcsServer(store_path=store)
+        await g3.start()
+        assert g3.kv[("ns", "c")] == b"3" and g3.kv[("ns", "a")] == b"1"
+        assert len(g3.timeseries) >= 1
+        assert g3.node_wal_tails.get("nodeX")
+
+        node = types.SimpleNamespace(node_id="nodeX", alive=True, conn=None)
+        await g3._on_node_dead(node, "test")
+        t = g3.task_events.get_task("t1")
+        assert t is not None and t["state"] == "RUNNING"
+        # idempotent: a second ingest of the same shipped tail dedups
+        g3.task_events.ingest(
+            [{"task_id": "t1", "state": "RUNNING", "ts": 1.0, "name": "f"}],
+            source="wal-ship-nodeX-again",
+        )
+        assert len(g3.task_events.get_task("t1")["events"]) == 1
+        await g3.server.close()
+        g3.wal.close()
+
+    asyncio.run(run())
+
+
+def test_orphan_shipped_tails_ingest_after_restore(tmp_path):
+    """A node that dies WHILE the GCS is down: only _on_node_dead ingests
+    shipped tails, and a node that never re-registers is never declared
+    dead "again" — the restore path must ingest its snapshot-restored
+    tails after the re-register grace window so the dead workers' task
+    timelines still close."""
+    from ray_tpu.core.config import _config
+    from ray_tpu.core.gcs.server import GcsServer
+
+    store = str(tmp_path / "gcs_store.pkl")
+    saved = _config.health_check_period_ms
+    _config.health_check_period_ms = 100  # grace = max(2.0, 0.5) = 2s
+
+    async def run():
+        conn = _mkconn()
+        g = GcsServer(store_path=store)
+        await g.start()
+        g.handle_ship_wal_tail(conn, "ghost", {"wal-ghost-1.jsonl": [
+            {"task_id": "tg", "state": "EXECUTED", "ts": 1.0, "name": "f"},
+        ]})
+        g._write_snapshot()
+        await g.server.close()
+        g.wal.close()
+
+        g2 = GcsServer(store_path=store)
+        await g2.start()
+        assert g2.node_wal_tails.get("ghost")
+        # "ghost" never re-registers; past the grace window its tails are
+        # ingested anyway and the timeline closes
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if g2.task_events.get_task("tg") is not None:
+                break
+            await asyncio.sleep(0.2)
+        t = g2.task_events.get_task("tg")
+        assert t is not None and t["state"] == "EXECUTED"
+        assert "ghost" not in g2.node_wal_tails
+        await g2.server.close()
+        g2.wal.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        _config.health_check_period_ms = saved
+
+
+def test_wal_disabled_restart_folds_leftover_segments(tmp_path):
+    """`gcs_wal_enabled` toggled OFF across a restart: leftover segments
+    are replayed (acked mutations survive the toggle), folded into a fresh
+    snapshot, and deleted — so a later re-ENABLED restart can't replay the
+    stale records over newer state (disabled-run snapshots carry wal_seq
+    0, which would otherwise resurrect deleted keys)."""
+    from ray_tpu.core.config import _config
+    from ray_tpu.core.gcs import wal as wal_mod
+    from ray_tpu.core.gcs.server import GcsServer
+
+    store = str(tmp_path / "gcs_store.pkl")
+
+    async def enabled_run():
+        conn = _mkconn()
+        g = GcsServer(store_path=store)
+        await g.start()
+        g.handle_kv_put(conn, "ns", "a", b"1")
+        # unclean death: the mutation lives ONLY in the WAL
+        await g.server.close()
+        g.wal.close()
+
+    asyncio.run(enabled_run())
+    assert wal_mod.list_segments(store + ".wal")
+
+    saved = _config.gcs_wal_enabled
+    _config.gcs_wal_enabled = False
+
+    async def disabled_run():
+        conn = _mkconn()
+        g = GcsServer(store_path=store)
+        await g.start()
+        assert g.kv[("ns", "a")] == b"1"  # folded from the leftover WAL
+        assert not wal_mod.list_segments(store + ".wal")
+        g.handle_kv_del(conn, "ns", "a")
+        g._write_snapshot()  # the disabled plane's snapshot (wal_seq 0)
+        await g.server.close()
+
+    try:
+        asyncio.run(disabled_run())
+    finally:
+        _config.gcs_wal_enabled = saved
+
+    async def reenabled_run():
+        g = GcsServer(store_path=store)
+        await g.start()
+        # the key deleted during the disabled run must NOT resurrect
+        assert ("ns", "a") not in g.kv
+        await g.server.close()
+        g.wal.close()
+
+    asyncio.run(reenabled_run())
+
+
+def test_head_state_offline_forensics(tmp_path, capsys):
+    """`scripts head-state` decodes snapshot + WAL with no running GCS."""
+    from ray_tpu.core.gcs.server import GcsServer
+    from ray_tpu import scripts
+
+    store = str(tmp_path / "gcs_store.pkl")
+
+    async def build():
+        conn = _mkconn()
+        g = GcsServer(store_path=store)
+        await g.start()
+        g.handle_kv_put(conn, "ns", "a", b"1")
+        g._write_snapshot()
+        g.handle_kv_put(conn, "ns", "b", b"2")
+        g.handle_register_driver(conn)
+        await g.server.close()
+        g.wal.close()
+
+    asyncio.run(build())
+    rc = scripts.main(["head-state", "--store", str(tmp_path), "--json"])
+    assert rc == 0
+    state = json.loads(capsys.readouterr().out)
+    assert state["snapshot_present"] is True
+    assert set(state["kv_keys"]) == {"ns/a", "ns/b"}
+    assert state["wal_records_replayed"] == 2  # kv_put b + job mint
+    assert state["job_counter"] == 1
+    # human-readable mode renders too
+    assert scripts.main(["head-state", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "kv keys:             2" in out
+
+
+# --------------------------------------------------------------------------
+# units: deadline clock-skew guard
+# --------------------------------------------------------------------------
+
+def test_effective_deadline_skew_guard():
+    from ray_tpu.core import task_spec as ts
+
+    # no mint info: pass through unchanged
+    assert ts.effective_deadline(123.0, None, None) == 123.0
+    assert ts.effective_deadline(None, 1.0, 1.0) is None
+
+    # same boot (wall/mono offsets agree): exact monotonic elapsed — 1s of
+    # a 2s budget spent, the localized deadline grants exactly 1s more
+    d = ts.effective_deadline(1002.0, 1000.0, 50.0,
+                              now_wall=1001.0, now_mono=51.0,
+                              tolerance_s=5.0)
+    assert abs(d - 1002.0) < 1e-9
+
+    # same boot, wall clock STEPPED +100s mid-flight: mono still measures
+    # the true 1s elapsed... but a 100s step breaks the offset match, so
+    # the cross-host clamp re-anchors the remaining budget instead of
+    # shedding a request that is 1s old
+    d = ts.effective_deadline(1002.0, 1000.0, 50.0,
+                              now_wall=1101.0, now_mono=51.0,
+                              tolerance_s=5.0)
+    assert d >= 1101.0  # never already-expired on a clock artifact
+
+    # cross-host (incomparable monotonic), clocks within tolerance: the
+    # minted wall deadline is used as-is (sheds stay exact)
+    d = ts.effective_deadline(1002.0, 1000.0, 987654.0,
+                              now_wall=1001.0, now_mono=3.0,
+                              tolerance_s=5.0)
+    assert d == 1002.0
+
+    # cross-host, receiver 10s AHEAD (NTP skew beyond the 5s tolerance):
+    # naive comparison would shed a fresh 2s-budget request instantly;
+    # the guard clamps — full budget re-anchored on the receiver's clock
+    d = ts.effective_deadline(1002.0, 1000.0, 987654.0,
+                              now_wall=1010.0, now_mono=3.0,
+                              tolerance_s=5.0)
+    assert abs(d - 1012.0) < 1e-9
+
+    # cross-host, receiver BEHIND: clamped the same way (no overstay past
+    # the granted budget + tolerance)
+    d = ts.effective_deadline(1002.0, 1000.0, 987654.0,
+                              now_wall=990.0, now_mono=3.0,
+                              tolerance_s=5.0)
+    assert abs(d - 992.0) < 1e-9
+
+
+def test_localize_deadline_one_shot():
+    from ray_tpu.core import task_spec as ts
+    from ray_tpu.core.ids import TaskID
+
+    spec = ts.TaskSpec(
+        task_id=TaskID.from_random(), name="t", fn_id=b"", args=[],
+        kwargs={}, num_returns=1, resources={}, owner_addr="a",
+        deadline=time.time() + 30.0,
+    )
+    spec.deadline_minted_wall = time.time()
+    spec.deadline_minted_mono = time.monotonic()
+    first = ts.localize_deadline(spec)
+    assert first is not None and first == spec.deadline
+    # second call is a no-op (already localized)
+    assert ts.localize_deadline(spec) == first
+    # specs without a deadline stay deadline-free
+    spec2 = ts.TaskSpec(
+        task_id=TaskID.from_random(), name="t", fn_id=b"", args=[],
+        kwargs={}, num_returns=1, resources={}, owner_addr="a",
+    )
+    assert ts.localize_deadline(spec2) is None
+
+
+# --------------------------------------------------------------------------
+# unit: quantile sketches across the dashboard JSON boundary
+# --------------------------------------------------------------------------
+
+def test_sketches_cross_dashboard_json_boundary():
+    """/api/timeseries carries each histogram's DDSketch JSON-safely, and
+    samples_from_dashboard_json reconstructs it — dashboard-sourced
+    percentiles match driver-side sketch math instead of bucket
+    interpolation (the PR-13 gap)."""
+    from ray_tpu.dashboard.app import timeseries_to_json
+    from ray_tpu.scripts import samples_from_dashboard_json
+    from ray_tpu.util import metrics as m
+
+    s = m._Series("lat_ms", "histogram", "", boundaries=[1, 100, 10000])
+    h = object.__new__(m.Histogram)
+    h._tag_keys = ("deployment",)
+    h._default_tags = {}
+    h._series = s
+    for v in (220, 230, 240, 250, 260, 270, 280, 290, 900, 990):
+        h.observe(v, tags={"deployment": "d"})
+    sample = {"ts": 12.0, "series": [s.snapshot()]}
+
+    wire = json.loads(json.dumps(timeseries_to_json([sample])))
+    back = samples_from_dashboard_json(wire)
+    assert back[0]["series"][0].get("sketches"), "sketch dropped by JSON"
+
+    p99_direct = m.window_percentile([sample], "lat_ms", 0.99,
+                                     {"deployment": "d"})
+    p99_wire = m.window_percentile(back, "lat_ms", 0.99, {"deployment": "d"})
+    assert p99_wire == pytest.approx(p99_direct)
+    # the sketch path is actually in effect: ±1% of the true p99 (990),
+    # where bucket interpolation inside [100, 10000] could be off by ~9x
+    assert abs(p99_wire - 990) / 990 < 0.02
+    # without sketches the same JSON degrades to bucket interpolation —
+    # proving the wire field is what carries the accuracy
+    for x in wire[0]["series"]:
+        x.pop("sketches", None)
+    p99_stripped = m.window_percentile(
+        samples_from_dashboard_json(wire), "lat_ms", 0.99,
+        {"deployment": "d"})
+    assert abs(p99_stripped - 990) / 990 > 0.05
+
+
+# --------------------------------------------------------------------------
+# cluster: acknowledged-mutation audit under a WAL-offset SIGKILL
+# --------------------------------------------------------------------------
+
+def _gcs_call(method, **kw):
+    from ray_tpu.api import _global_worker
+
+    core = _global_worker().backend.core
+
+    async def call():
+        return await core.gcs.call(method, timeout=30, **kw)
+
+    return core.io.run(call(), timeout=60)
+
+
+@pytest.mark.chaos(timeout=240)
+def test_gcs_kill_at_wal_offset_loses_no_acked_mutations():
+    """The acceptance audit: SIGKILL the GCS right after the Nth WAL record
+    (no pre-exit flush — `_chaos_pre_exit` is retired), restart it, and
+    every kv_put that was ACKNOWLEDGED must be present. Soft state (metrics
+    ring samples, task history) recorded before the kill survives through
+    the full-table snapshot."""
+    import ray_tpu as ray
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.testing import chaos
+
+    ray.shutdown()
+    plan = chaos.plan(11).kill_gcs_at_wal(nth=12, match="kv_put")
+    os.environ["RAY_TPU_GCS_SNAPSHOT_INTERVAL_S"] = "2"
+    try:
+        with plan:
+            c = Cluster(head_node_args={"num_cpus": 2})
+            ray.init(address=c.address)
+    finally:
+        os.environ.pop("RAY_TPU_GCS_SNAPSHOT_INTERVAL_S", None)
+    try:
+        # some task history + metrics ring samples, then outlive one
+        # snapshot tick so the soft state is captured
+        @ray.remote
+        def f(x):
+            return x + 1
+
+        pre_kill_ref = f.remote(1)
+        assert ray.get(pre_kill_ref, timeout=60) == 2
+        time.sleep(4.0)
+        t_kill = time.time()
+
+        acked = []
+        failed_key = None
+        for i in range(40):
+            key = f"k{i:02d}"
+            try:
+                assert _gcs_call("kv_put", ns="audit", key=key,
+                                 value=str(i).encode())
+                acked.append(key)
+            except Exception:  # noqa: BLE001 - the injected crash
+                failed_key = key
+                break
+        assert failed_key is not None, "chaos kill never fired"
+        assert [e["point"] for e in plan.events()] == ["gcs.wal"]
+        assert c.wait_gcs_exit(30), "GCS process must be dead"
+        c.restart_gcs()
+
+        # every ACKED mutation is back (reconnect window ridden out)
+        deadline = time.time() + 60
+        recovered = None
+        while time.time() < deadline:
+            try:
+                recovered = {
+                    k: _gcs_call("kv_get", ns="audit", key=k) for k in acked
+                }
+                break
+            except Exception:  # noqa: BLE001 - reconnecting
+                time.sleep(0.5)
+        assert recovered is not None, "driver never reattached"
+        missing = [k for k, v in recovered.items() if v is None]
+        assert not missing, f"ACKNOWLEDGED mutations lost: {missing}"
+
+        # snapshot soft state survived: pre-kill metric samples + the
+        # pre-kill task's history are still there
+        from ray_tpu.util import state
+
+        samples = state.get_metrics_timeseries()
+        assert any(s["ts"] < t_kill for s in samples), \
+            "metrics ring lost across restart"
+        t = state.get_task(pre_kill_ref.task_id.hex())
+        assert t is not None and t["state"] == "FINISHED", t
+
+        # and the cluster still runs fresh work
+        assert ray.get(f.remote(5), timeout=60) == 6
+    finally:
+        ray.shutdown()
+        c.shutdown()
+
+
+# --------------------------------------------------------------------------
+# cluster: serve keeps answering through a real GCS SIGKILL
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout=240)
+def test_serve_keeps_answering_through_gcs_restart():
+    """A serve deployment under continuous load rides out a hard GCS kill +
+    restart: every request either succeeds or fails TYPED (RayTpuError),
+    traffic succeeds both before and after the restart, and the fleet never
+    stops answering for the whole window."""
+    import ray_tpu as ray
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu import exceptions as exc
+
+    ray.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    ray.init(address=c.address)
+    try:
+        @serve.deployment(name="echo")
+        def echo(x):
+            return x * 2
+
+        handle = serve.run(echo)
+        assert ray.get(handle.remote(3), timeout=60) == 6
+
+        results = {"ok": 0, "typed": 0, "untyped": []}
+        restarted = threading.Event()
+        ok_after_restart = threading.Event()
+        stop = threading.Event()
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    assert ray.get(handle.remote(i), timeout=10) == 2 * i
+                    results["ok"] += 1
+                    if restarted.is_set():
+                        ok_after_restart.set()
+                except exc.RayTpuError:
+                    results["typed"] += 1
+                except Exception as e:  # noqa: BLE001
+                    results["untyped"].append(repr(e))
+                time.sleep(0.01)
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        time.sleep(1.5)
+        assert results["ok"] > 0
+        c.kill_gcs()          # real SIGKILL mid-storm, no flush
+        time.sleep(1.0)
+        c.restart_gcs()
+        restarted.set()
+        assert ok_after_restart.wait(30), (
+            f"serve stopped answering after GCS restart: {results}"
+        )
+        time.sleep(2.0)
+        stop.set()
+        t.join(timeout=30)
+        assert not results["untyped"], results["untyped"]
+        assert results["ok"] > 20, results
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray.shutdown()
+        c.shutdown()
+
+
+# --------------------------------------------------------------------------
+# cluster: whole-node SIGKILL → shipped WAL tails close the timeline
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout=240)
+def test_node_loss_shipped_wal_closes_timeline():
+    """Kill an entire node (raylet SIGKILL; its workers die with it). The
+    dead workers' task-event WALs were shipped to the GCS beforehand, so
+    the node death ingests them and the last task's worker-side states
+    appear WITHOUT any same-host sweep (asserted well inside the sweep's
+    60s floor) — the PR-8 'WAL recovery doesn't cover whole-node loss'
+    gap."""
+    import ray_tpu as ray
+    from ray_tpu.cluster_utils import Cluster
+
+    ray.shutdown()
+    # workers flush every 60s -> their events live ONLY in the WAL; tails
+    # ship every 300ms
+    os.environ["RAY_TPU_TASK_EVENTS_FLUSH_INTERVAL_MS"] = "60000"
+    os.environ["RAY_TPU_TASK_EVENTS_WAL_SHIP_INTERVAL_MS"] = "300"
+    try:
+        c = Cluster(head_node_args={"num_cpus": 1})
+        victim = c.add_node(num_cpus=1, resources={"n2": 1})
+        ray.init(address=c.address)
+        try:
+            c.wait_for_nodes(2)
+
+            @ray.remote(resources={"n2": 0.5}, max_restarts=0)
+            class Pinned:
+                def work(self):
+                    return os.getpid()
+
+            a = Pinned.remote()
+            ref = a.work.remote()
+            ray.get(ref, timeout=60)
+            time.sleep(1.5)  # >= a few ship ticks
+
+            t_kill = time.monotonic()
+            c.kill_node(victim)
+
+            from ray_tpu.util import state
+
+            deadline = time.monotonic() + 45
+            states = []
+            while time.monotonic() < deadline:
+                t = state.get_task(ref.task_id.hex())
+                states = [e["state"] for e in (t or {}).get("events", [])]
+                if "EXECUTED" in states:
+                    break
+                time.sleep(0.5)
+            elapsed = time.monotonic() - t_kill
+            assert "EXECUTED" in states, (
+                f"shipped WAL tail never closed the timeline: {states}"
+            )
+            assert elapsed < 45, elapsed
+        finally:
+            ray.shutdown()
+            c.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_TASK_EVENTS_FLUSH_INTERVAL_MS", None)
+        os.environ.pop("RAY_TPU_TASK_EVENTS_WAL_SHIP_INTERVAL_MS", None)
+
+
+# --------------------------------------------------------------------------
+# cluster: chaos plan propagation to already-running daemons
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout=180)
+def test_chaos_activate_reaches_running_daemons():
+    """chaos.activate pushes a plan over rpc to daemons that were ALREADY
+    running when the plan was built (the env-var path can't reach them):
+    the raylet fires a worker.lease kill and the task still completes via
+    the owner's retry."""
+    import ray_tpu as ray
+    from ray_tpu.testing import chaos
+
+    ray.shutdown()
+    ray.init(num_cpus=2, num_tpus=0)  # NO plan active at spawn time
+    try:
+        plan = chaos.plan(5).kill_worker(after_tasks=1)
+        n = chaos.activate(plan)
+        assert n >= 2, f"GCS + raylet must accept the push, got {n}"
+
+        @ray.remote
+        def f(x):
+            return x + 10
+
+        assert ray.get(f.remote(1), timeout=120) == 11
+        deadline = time.monotonic() + 30
+        events = []
+        while time.monotonic() < deadline:
+            events = [e for e in plan.events()
+                      if e["point"] == "worker.lease"]
+            if events:
+                break
+            time.sleep(0.25)
+        assert events, "pushed plan never fired in the raylet"
+        assert events[0]["action"] == "kill"
+        assert events[0]["pid"] != os.getpid(), "must fire in a daemon"
+
+        # the counterpart: deactivate clears the driver env AND reaches
+        # the same daemons, so a reused cluster stops firing
+        n = chaos.deactivate()
+        assert n >= 2, f"daemons must accept the deactivation, got {n}"
+        assert chaos.ENV_PLAN not in os.environ
+        assert chaos.active() is None
+    finally:
+        chaos.deactivate()
+        ray.shutdown()
+
+
+# --------------------------------------------------------------------------
+# cluster: serve controller checkpoint restore (durable routing state)
+# --------------------------------------------------------------------------
+
+def test_serve_controller_checkpoint_restores_deployments():
+    """The controller checkpoints its deployment targets into the durable
+    GCS KV (which rides the WAL): after the controller actor is killed
+    outright, a fresh serve.start() rebuilds the SAME deployments from the
+    checkpoint and traffic flows again — no redeploy from the driver."""
+    import ray_tpu as ray
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    ray.shutdown()
+    ray.init(num_cpus=2, num_tpus=0)
+    try:
+        @serve.deployment(name="ckpt_echo")
+        def echo(x):
+            return x + 100
+
+        handle = serve.run(echo)
+        assert ray.get(handle.remote(1), timeout=60) == 101
+
+        # kill the controller hard: its (owned) replicas die with it
+        controller = ray.get_actor(serve_api.CONTROLLER_NAME)
+        ray.kill(controller)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                ray.get_actor(serve_api.CONTROLLER_NAME)
+                time.sleep(0.25)
+            except Exception:  # noqa: BLE001 - controller gone
+                break
+
+        # a fresh attach (new driver semantics): controller restores the
+        # checkpoint, reconcile restarts the replica fleet
+        serve_api._local.clear()
+        serve.start()
+        deadline = time.monotonic() + 60
+        value = None
+        while time.monotonic() < deadline:
+            try:
+                h = serve.get_handle("ckpt_echo")
+                value = ray.get(h.remote(2), timeout=10)
+                break
+            except Exception:  # noqa: BLE001 - fleet still rebuilding
+                time.sleep(0.5)
+        assert value == 102, (
+            f"checkpointed deployment did not come back: {value!r} "
+            f"(status={serve.status()})"
+        )
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray.shutdown()
