@@ -231,7 +231,12 @@ class ClusterBackend(Backend):
         )
         self.core.connect()
         raylet_addr, raylet_session, raylet_node = self._wait_local_raylet(
-            prefer_node=node_id
+            prefer_node=node_id,
+            # an EXPLICIT _node_name pin must wait for that raylet to
+            # register, never silently adopt whichever node won the
+            # registration race (split-session tests/benches depend on the
+            # driver sitting on the named node)
+            require=node_name is not None,
         )
         self.core.raylet_address = raylet_addr
         self.core.session = raylet_session
@@ -244,22 +249,27 @@ class ClusterBackend(Backend):
             rpc.connect(raylet_addr, handler=self.core, name="driver->raylet")
         )
 
-    def _wait_local_raylet(self, prefer_node: str, timeout=30.0):
+    def _wait_local_raylet(self, prefer_node: str, timeout=30.0,
+                           require: bool = False):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             nodes = self.core.io.run(self.core.gcs.call("get_nodes"))
             if nodes:
                 node = next(
-                    (n for n in nodes if n["NodeID"] == prefer_node), nodes[0]
+                    (n for n in nodes if n["NodeID"] == prefer_node),
+                    None if require else nodes[0],
                 )
-                if node["Alive"]:
+                if node is not None and node["Alive"]:
                     return (
                         node["NodeManagerAddress"],
                         node["Session"],
                         node["NodeID"],
                     )
             time.sleep(0.1)
-        raise exc.RayTpuError("no raylet registered within timeout")
+        raise exc.RayTpuError(
+            f"raylet {prefer_node!r} not registered within timeout"
+            if require else "no raylet registered within timeout"
+        )
 
     # ------------------------------------------------------------- Backend
     def submit_task(self, func, args, kwargs, options):

@@ -62,6 +62,13 @@ class Config:
     # owner-side lease caching (SchedulingKey reuse): an idle cached lease
     # returns to its raylet after this long without a task
     worker_lease_idle_ttl_ms: int = 500
+    # locality-aware lease scheduling: lease requests carry per-arg
+    # (oid, nbytes, node) hints, and a raylet choosing between feasible
+    # nodes subtracts locality_weight * (resident hinted bytes / total
+    # hinted bytes) from each candidate's utilization score — a node
+    # already holding the largest args wins ties instead of forcing a
+    # transfer. 0 disables locality entirely (hints still ride the wire).
+    locality_weight: float = 0.5
 
     # pipelined task submission (reference: max_tasks_in_flight_per_worker in
     # the direct task submitter, default 10): up to this many submissions
@@ -95,6 +102,33 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     object_spilling_dir: str = ""
     object_store_full_delay_ms: int = 100
+
+    # --- object plane: pull-based transfer (object_store/pull_manager.py) ---
+    # chunked pulls over the stream transport: big objects cross nodes as
+    # ~pull_chunk_bytes chunks landing straight into a pre-created
+    # create->seal shm buffer, resumable from the next missing chunk after
+    # a severed stream; False degrades to the native-daemon / rpc paths
+    pull_chunked_enabled: bool = True
+    pull_chunk_bytes: int = 4 * 1024 * 1024
+    # credits per chunk stream (max unacked chunks in flight per source)
+    pull_chunk_window: int = 8
+    # objects at least this large with >1 known holder stripe disjoint
+    # chunk ranges across sources instead of pulling from one
+    pull_stripe_min_bytes: int = 16 * 1024 * 1024
+    # max concurrent sources one pull stripes across
+    pull_max_stripe: int = 2
+    # PullManager admission: total bytes of concurrently-executing pulls on
+    # one raylet; excess pulls queue (task-arg pulls ahead of prefetches)
+    pull_max_inflight_bytes: int = 256 * 1024 * 1024
+    # size-scaled transfer deadline: every fetch/pull call gets
+    # base + nbytes/1GiB * per_gb seconds, so multi-GB objects on slow
+    # links don't spuriously fail mid-transfer on a fixed timeout
+    object_transfer_timeout_base_s: float = 60.0
+    object_transfer_timeout_per_gb_s: float = 60.0
+    # arg prefetch: a raylet starts pulling a queued lease's remote args
+    # (from the request's locality hints) while the lease waits for a
+    # worker, overlapping transfer with scheduling delay
+    arg_prefetch_enabled: bool = True
 
     # --- rpc wire path (frame coalescing / zero-copy, core/rpc.py) ----------
     # outbox flushes once per loop tick; past this many buffered bytes it

@@ -605,7 +605,18 @@ class CoreWorker:
         oid = ObjectID.for_task_return(TaskID(key), index)
         self._own(oid)
         if kind == "inline":
-            self.memory_store.put_value(oid, rpc.unwrap_oob(payload))
+            data = rpc.unwrap_oob(payload)
+            if (self.raylet is not None
+                    and index - state.consumed
+                    >= max(1, _config.streaming_max_inflight_items)):
+                # overflow spill: an explicitly-windowed producer may run
+                # far ahead of its consumer — unconsumed items past the
+                # config bound land in the shm store (restored through the
+                # normal location path on consume) instead of growing the
+                # owner heap without bound
+                self._spill_stream_item(oid, data)
+            else:
+                self.memory_store.put_value(oid, data)
         elif kind == "location":
             self.locations[oid] = payload
             self.memory_store.put_value(oid, None)  # shm-location marker
@@ -619,6 +630,25 @@ class CoreWorker:
             if state.closed:
                 return {"closed": True}
         return {"consumed": state.consumed}
+
+    _m_stream_spills = None
+
+    def _spill_stream_item(self, oid: ObjectID, data) -> None:
+        """Write one overflowing stream item to the local shm store with a
+        location marker; the consumer's get restores it transparently
+        (locations → _read_location → local shm read) and the normal free
+        path reclaims it."""
+        self._put_shm(oid, data)  # shm write + location record + notify
+        self.memory_store.put_value(oid, None)  # shm-location marker
+        if _config.metrics_enabled:
+            if CoreWorker._m_stream_spills is None:
+                from ray_tpu.util.metrics import Counter
+
+                CoreWorker._m_stream_spills = Counter(
+                    "streaming_spilled_items_total",
+                    "overflowing stream items spilled to the shm store",
+                )
+            CoreWorker._m_stream_spills.inc(1.0)
 
     # ------------------------------------------------------------- put/get
     # tracing: put/get record "core.put"/"core.get" spans, but only for
@@ -906,19 +936,27 @@ class CoreWorker:
             if buf is not None:
                 return buf.buffer
         # remote node: ask local raylet to pull, then read locally. A failing
-        # pull (source node dead) must fall through to the direct fetch and
-        # ultimately ObjectLostError → lineage reconstruction, not raise.
+        # pull (source node dead, typed store-full refusal) must fall
+        # through to the direct fetch and ultimately ObjectLostError →
+        # lineage reconstruction, not raise. Timeouts scale with object
+        # size (object_transfer_timeout_* knobs): a multi-GB object on a
+        # slow link must not die to a fixed deadline mid-transfer.
+        from ray_tpu.core.object_store.chunk_transfer import transfer_timeout
+
+        timeout = transfer_timeout(loc.get("nbytes"))
         if self.raylet is not None:
             try:
-                ok = await self.raylet.call(
+                reply = await self.raylet.call(
                     "pull_object",
                     oid_hex=oid.hex(),
                     source_addr=loc["raylet_addr"],
                     nbytes=loc.get("nbytes"),
-                    timeout=120,
+                    priority="arg",
+                    timeout=timeout + 30,
                 )
             except (rpc.RpcError, rpc.ConnectionLost):
-                ok = False
+                reply = None
+            ok = (reply.get("ok") if isinstance(reply, dict) else bool(reply))
             if ok:
                 buf = self.shm.get(oid)
                 if buf is not None:
@@ -927,7 +965,9 @@ class CoreWorker:
         conn = await self._conn_to(loc["raylet_addr"], kind="raylet")
         if conn is not None:
             try:
-                data = await conn.call("fetch_object", oid_hex=oid.hex(), timeout=120)
+                data = await conn.call(
+                    "fetch_object", oid_hex=oid.hex(), timeout=timeout
+                )
                 if data is not None:
                     return rpc.unwrap_oob(data)
             except (rpc.RpcError, rpc.ConnectionLost):
@@ -1270,13 +1310,49 @@ class CoreWorker:
     # return_lease round trip per task. Idle leases return after a TTL so
     # cached capacity doesn't starve other keys/drivers.
 
+    def _arg_hints(self, spec: ts.TaskSpec) -> Optional[list]:
+        """Owner-known locations of the spec's by-reference args, largest
+        first: ``[(oid_hex, nbytes, node_id)]``. Rides the lease request so
+        the raylet can prefer the node already holding the bytes and
+        prefetch the rest. Cached on the spec — retries re-send the same
+        hints, and the scheduling key reads them too."""
+        cached = getattr(spec, "_arg_hints", None)
+        if cached:
+            return cached
+        hints = []
+        for ref in spec.dependencies():
+            loc = self.locations.get(ref.id)
+            if loc and loc.get("node_id") and loc.get("nbytes"):
+                hints.append((ref.id.hex(), int(loc["nbytes"]),
+                              loc["node_id"]))
+        hints.sort(key=lambda h: -h[1])
+        hints = hints[:8] or None
+        if hints:
+            # cache only NON-empty hints: a pipelined submission computes
+            # this before its producing task finished (no location yet) —
+            # a cached None would blind every retry to the by-then-known
+            # locations of its largest args
+            spec._arg_hints = hints
+        return hints
+
     def _sched_key(self, spec: ts.TaskSpec):
+        # big-arg tasks get a locality domain in their key: cached-lease
+        # reuse skips the raylet entirely, so without this a lease granted
+        # for node-A data would silently serve node-B-data tasks and the
+        # locality hints could never matter past the first grant
+        hints = self._arg_hints(spec)
+        locality_domain = (
+            hints[0][2]
+            if hints and hints[0][1] >= _config.pull_chunk_bytes
+            else None
+        )
         return (
             tuple(sorted(spec.resources.items())),
             spec.placement_group_id,
             spec.placement_group_bundle_index,
             repr(spec.runtime_env),
             repr(spec.scheduling_strategy),
+            locality_domain,
         )
 
     def _lease_pool(self, key) -> "_LeasePool":
@@ -1570,6 +1646,14 @@ class CoreWorker:
             if raylet is None or raylet.closed:
                 return
             raylet_addr = self.raylet_address
+            # hints ride the batch only for big-arg scheduling keys: there
+            # the locality domain in the key makes every spec's largest
+            # arg live on the SAME node, so one spec's hints represent the
+            # whole batch; small-arg keys mix tasks with different arg
+            # homes and a representative hint would mislead all of them
+            hints = self._arg_hints(spec)
+            if not (hints and hints[0][1] >= _config.pull_chunk_bytes):
+                hints = None
             try:
                 replies = await raylet.call(
                     "request_lease_batch",
@@ -1577,6 +1661,7 @@ class CoreWorker:
                     count=count,
                     pg_id=spec.placement_group_id,
                     bundle_index=spec.placement_group_bundle_index,
+                    arg_hints=hints,
                     timeout=None,
                 )
             except (rpc.RpcError, rpc.ConnectionLost):
@@ -1663,6 +1748,9 @@ class CoreWorker:
                     task_id=spec.task_id.hex(),
                     task_name=spec.name,
                     trace_id=getattr(spec, "trace_id", None),
+                    # locality: where this task's by-ref args live, so the
+                    # raylet can grant near the bytes / prefetch the rest
+                    arg_hints=self._arg_hints(spec),
                     timeout=None,
                 )
             except rpc.ConnectionLost as e:

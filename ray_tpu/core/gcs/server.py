@@ -183,6 +183,15 @@ class GcsServer:
         # torn-down channel's stragglers exit typed.
         self.channel_endpoints: Dict[str, dict] = {}
         self._endpoint_events: Dict[str, asyncio.Event] = {}
+        # object plane: secondary-copy directory (oid_hex -> {node_id:
+        # nbytes}, insertion-ordered). Raylets register here after a
+        # completed pull and deregister on eviction/free, so later pullers
+        # of a hot object fetch from a spread of holders (distribution
+        # tree) instead of hammering the owner node. Soft state by design:
+        # not snapshotted/WAL'd — after a GCS restart pulls fall back to
+        # the owner-recorded primary location and the table re-fills.
+        self.object_locations: Dict[str, Dict[str, int]] = {}
+        self._object_loc_rr: Dict[str, int] = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -698,6 +707,14 @@ class GcsServer:
         # that host — ingest the tails it shipped here while alive, closing
         # the dead workers' timelines (idempotent wal- source dedup)
         self._ingest_shipped_wals(node.node_id)
+        # a dead node serves no object copies: drop its directory entries
+        # so pullers never stripe against a ghost holder
+        for oid_hex in list(self.object_locations):
+            holders = self.object_locations[oid_hex]
+            holders.pop(node.node_id, None)
+            if not holders:
+                self.object_locations.pop(oid_hex, None)
+                self._object_loc_rr.pop(oid_hex, None)
         await self.publish("node", {"event": "dead", "node_id": node.node_id})
         # fail over actors on that node
         for actor in list(self.actors.values()):
@@ -726,6 +743,51 @@ class GcsServer:
         return n
 
     # ----------------------------------------------------------------- kv
+    # ------------------------------------------- object-location directory
+    def handle_object_location_add(self, conn, oid_hex, node_id, nbytes):
+        """A raylet completed a pull: record it as a secondary holder."""
+        self.object_locations.setdefault(oid_hex, {})[node_id] = int(nbytes)
+        return True
+
+    def handle_object_location_remove(self, conn, entries):
+        """Batched deregistration: [(oid_hex, node_id)] whose local copy
+        was evicted or freed."""
+        for oid_hex, node_id in entries:
+            holders = self.object_locations.get(oid_hex)
+            if holders is None:
+                continue
+            holders.pop(node_id, None)
+            if not holders:
+                self.object_locations.pop(oid_hex, None)
+                self._object_loc_rr.pop(oid_hex, None)
+        return True
+
+    def handle_object_locations(self, conn, oid_hex):
+        """Alive registered holders of an object, as dial-ready dicts.
+        The list is ROTATED one step per query (round-robin), so N pullers
+        of one hot object spread across the holder set — the broadcast
+        distribution tree — instead of all dialing the first holder."""
+        holders = self.object_locations.get(oid_hex)
+        if not holders:
+            return []
+        out = []
+        for node_id, nbytes in holders.items():
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                continue
+            out.append({
+                "node_id": node_id,
+                "address": node.address,
+                "session": node.session,
+                "transfer_port": getattr(node, "transfer_port", None),
+                "nbytes": nbytes,
+            })
+        if len(out) > 1:
+            rot = self._object_loc_rr.get(oid_hex, 0) % len(out)
+            out = out[rot:] + out[:rot]
+        self._object_loc_rr[oid_hex] = self._object_loc_rr.get(oid_hex, 0) + 1
+        return out
+
     def handle_kv_put(self, conn, ns, key, value, overwrite=True):
         k = (ns, key)
         if not overwrite and k in self.kv:

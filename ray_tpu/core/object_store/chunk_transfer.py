@@ -1,0 +1,160 @@
+"""Chunked object transfer over the stream transport (core/transport/).
+
+Parity: src/ray/object_manager/ PullManager/PushManager chunking — the
+reference moves objects as ``chunk_size`` pieces through its dedicated data
+plane; here each chunk rides one DATA frame of a PR-9 credit-gated stream
+and lands **straight into the destination's pre-created ``create→seal``
+shm mmap** at ``index * chunk_bytes`` (no spool file, no reassembly copy).
+
+Wire shape per chunk (one stream DATA frame)::
+
+    payload = CHUNK_HDR(index, total_nbytes)      # 16 bytes, no pickle
+    bufs    = [mmap slice of the sealed source object]
+
+Because chunks are self-describing, a severed stream loses nothing already
+landed: the receiver reports the missing index set and the pull manager
+resumes exactly those chunks — against the same holder or a different one
+(a fresh stream restarts seq at 0, so per-stream seq framing still holds).
+Disjoint index sets from multiple holders stripe into one mmap.
+
+The sender side runs on a plain thread (blocking sockets, like every
+transport writer); chaos point ``object.pull`` fires once per chunk there,
+so a plan can sever a pull mid-stream deterministically.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional, Sequence, Set
+
+from ray_tpu.core.transport import stream
+from ray_tpu.testing import chaos
+
+CHUNK_HDR = struct.Struct("<QQ")  # chunk index, object total nbytes
+
+
+def chunk_count(nbytes: int, chunk_bytes: int) -> int:
+    return max(1, (int(nbytes) + chunk_bytes - 1) // chunk_bytes)
+
+
+def transfer_timeout(nbytes: Optional[int]) -> float:
+    """Size-scaled transfer deadline: base + per-GiB term, so a multi-GB
+    object on a slow link is never failed by a fixed timeout while a
+    genuinely-stalled transfer still surfaces."""
+    from ray_tpu.core.config import _config
+
+    base = _config.object_transfer_timeout_base_s
+    if not nbytes:
+        return base
+    return base + (int(nbytes) / (1 << 30)) * _config.object_transfer_timeout_per_gb_s
+
+
+class ChunkReceiver(stream.ReaderState):
+    """Receiving end of one chunk stream: frames land in the destination
+    mmap instead of a spool file, credits grant per chunk landed.
+
+    Registered with the process :class:`stream.StreamListener` like any
+    channel reader; the source raylet dials it after the ``push_chunks``
+    rpc. ``wait()`` (executor thread, never the io loop) blocks until every
+    expected chunk landed or the stream ended."""
+
+    def __init__(self, channel_id: str, token: str, mm, nbytes: int,
+                 chunk_bytes: int, expected: Set[int], spool_dir: str):
+        super().__init__(channel_id, token,
+                         max_msgs=_chunk_window(), spool_dir=spool_dir)
+        self._mm = mm
+        self._nbytes = int(nbytes)
+        self._chunk_bytes = int(chunk_bytes)
+        self.expected = set(expected)
+        self.received: Set[int] = set()
+        self.bytes_landed = 0
+        self._done = threading.Event()
+
+    # ------------------------------------------------------------- landing
+    def _recv_data(self, sock, seq: int) -> None:
+        plen, nbuf = stream._DATA_HDR.unpack(
+            stream._recv_exact(sock, stream._DATA_HDR.size)
+        )
+        if plen != CHUNK_HDR.size or nbuf != 1:
+            raise ValueError(f"malformed chunk frame (plen={plen}, nbuf={nbuf})")
+        size = stream._U64.unpack(stream._recv_exact(sock, 8))[0]
+        if seq != self._next_seq:
+            raise ValueError(
+                f"stream seq gap: expected {self._next_seq}, got {seq}"
+            )
+        self._next_seq += 1
+        index, total = CHUNK_HDR.unpack(stream._recv_exact(sock, plen))
+        off = index * self._chunk_bytes
+        want = min(self._chunk_bytes, self._nbytes - off)
+        if total != self._nbytes or index not in self.expected or size != want:
+            raise ValueError(
+                f"chunk {index} mismatch (size={size}, want={want}, "
+                f"total={total})"
+            )
+        stream._recv_into_exact(sock, memoryview(self._mm)[off:off + size])
+        with self._cond:
+            self.received.add(index)
+            self.bytes_landed += size
+        self._grant_credit()
+        if self.expected <= self.received:
+            self._done.set()
+
+    def _end(self, kind: str, why: str) -> None:
+        super()._end(kind, why)
+        self._done.set()
+
+    # ------------------------------------------------------------ consumer
+    def missing(self) -> Set[int]:
+        return self.expected - self.received
+
+    def wait(self, timeout: float) -> None:
+        """Block until complete / severed / timeout (executor thread)."""
+        self._done.wait(timeout)
+
+
+def _chunk_window() -> int:
+    from ray_tpu.core.config import _config
+
+    return max(1, _config.pull_chunk_window)
+
+
+def push_chunks_blocking(buf, oid_hex: str, indices: Sequence[int],
+                         nbytes: int, chunk_bytes: int, host: str, port: int,
+                         channel_id: str, token: str) -> int:
+    """Source side: stream the requested chunk indices of a sealed object
+    to a puller's :class:`ChunkReceiver`. Runs on an executor thread in the
+    source raylet; ``buf`` is the pinned :class:`ShmBuffer` (its mapping
+    outlives eviction-unlink, so a concurrent evictor never races us).
+    Returns bytes sent (0 when the stream failed — the puller's missing
+    set drives the resume)."""
+    mv = buf.buffer
+    sent = 0
+    try:
+        w = stream.connect_writer(host, port, channel_id, token)
+    except (stream.TransportError, stream.StreamTimeoutError):
+        return 0
+    try:
+        for index in sorted(indices):
+            act = chaos.fire("object.pull", key=oid_hex)
+            if act is not None and act["action"] == "sever":
+                w.sever("chaos object.pull")
+                return sent
+            off = index * chunk_bytes
+            size = min(chunk_bytes, nbytes - off)
+            try:
+                w.send_frame(CHUNK_HDR.pack(index, nbytes),
+                             [mv[off:off + size]],
+                             timeout=transfer_timeout(size))
+            except (stream.TransportError, stream.StreamTimeoutError):
+                # severed mid-push OR the puller stalled its credits past
+                # the deadline: stop; the puller resumes from its missing
+                # set (StreamTimeoutError is a GetTimeoutError, NOT a
+                # TransportError — it must not escape the push thread)
+                return sent
+            sent += size
+        w.close()
+    finally:
+        if not w.closed:
+            w.sever("push abandoned")
+    return sent

@@ -151,6 +151,10 @@ class ObjectDirectory:
         self.client = client
         self.capacity = capacity_bytes
         self.used = 0
+        # bytes promised to in-flight ingests (pulls mid-transfer): they
+        # count against free space so concurrent ensure/reserve calls
+        # can't all validate against the same headroom and overcommit
+        self.reserved = 0
         self.entries: Dict[ObjectID, _Entry] = {}
         # Spilling is the eviction safety net (eviction never destroys the
         # only copy), so a spill dir always exists — default: a per-node
@@ -160,6 +164,12 @@ class ObjectDirectory:
         )
         self.spilled: Dict[ObjectID, str] = {}
         self._lock = _san.make_lock("core.shm_store")
+        self.evictions = 0
+        # raylet hook: called with the evicted oids AFTER the lock drops
+        # (the raylet deregisters secondary copies from the GCS location
+        # table so stale holders never serve a vanished object)
+        self.evict_listener = None
+        self._pending_evicted: list = []
 
     def add(self, oid: ObjectID, nbytes: int):
         with self._lock:
@@ -170,6 +180,7 @@ class ObjectDirectory:
             self.used += nbytes
             if self.used > self.capacity:
                 self._evict_locked(self.used - self.capacity)
+        self._notify_evicted()
 
     def touch(self, oid: ObjectID):
         e = self.entries.get(oid)
@@ -190,10 +201,42 @@ class ObjectDirectory:
 
     def ensure_capacity(self, nbytes: int) -> bool:
         with self._lock:
-            free = self.capacity - self.used
+            free = self.capacity - self.used - self.reserved
             if free >= nbytes:
                 return True
-            return self._evict_locked(nbytes - free)
+            ok = self._evict_locked(nbytes - free)
+        self._notify_evicted()
+        return ok
+
+    def reserve(self, nbytes: int) -> bool:
+        """ensure_capacity that also RESERVES the bytes: the promise holds
+        against every later ensure/reserve until release_reservation. The
+        ingest path reserves before bytes land and releases right before
+        its `add` accounts them for real."""
+        nbytes = int(nbytes)
+        with self._lock:
+            free = self.capacity - self.used - self.reserved
+            ok = free >= nbytes or self._evict_locked(nbytes - free)
+            if ok:
+                self.reserved += nbytes
+        self._notify_evicted()
+        return ok
+
+    def release_reservation(self, nbytes: int) -> None:
+        with self._lock:
+            self.reserved = max(0, self.reserved - int(nbytes))
+
+    def _notify_evicted(self) -> None:
+        """Deliver eviction notifications queued under the lock."""
+        if not self._pending_evicted:
+            return
+        evicted, self._pending_evicted = self._pending_evicted, []
+        cb = self.evict_listener
+        if cb is not None:
+            try:
+                cb(evicted)
+            except Exception:  # noqa: BLE001 - bookkeeping never breaks eviction
+                pass
 
     def delete(self, oid: ObjectID):
         with self._lock:
@@ -233,6 +276,8 @@ class ObjectDirectory:
             self.client.delete(oid)
             self.used -= e.nbytes
             freed += e.nbytes
+            self.evictions += 1
+            self._pending_evicted.append(oid)
         return freed >= need
 
     def _spill(self, oid: ObjectID):
@@ -270,4 +315,5 @@ class ObjectDirectory:
             "used_bytes": self.used,
             "capacity_bytes": self.capacity,
             "num_spilled": len(self.spilled),
+            "num_evicted": self.evictions,
         }

@@ -13,6 +13,7 @@ cluster view.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import os
 import time
@@ -24,9 +25,15 @@ from ray_tpu import tracing
 from ray_tpu.core import rpc
 from ray_tpu.core.config import _config
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store.pull_manager import PullManager
 from ray_tpu.core.object_store.shm_store import ObjectDirectory, ShmClient
 from ray_tpu.core.resources import ResourceSet
-from ray_tpu.core.scheduling_policy import NodeView, hybrid_policy
+from ray_tpu.core.scheduling_policy import (
+    NodeView,
+    hybrid_policy,
+    locality_policy,
+    locality_score,
+)
 from ray_tpu.core.raylet.worker_pool import (
     ACTOR,
     DEAD,
@@ -57,6 +64,16 @@ class LeaseRequest:
     task_id: Optional[str] = None
     task_name: str = ""
     trace_id: Optional[str] = None
+    # locality: owner-recorded (oid_hex, nbytes, node_id) locations of the
+    # task's by-reference args — dispatch prefers a feasible node already
+    # holding the largest args, and queued leases prefetch remote args
+    arg_hints: Optional[list] = None
+    # one locality-driven spillback attempt per lease (no ping-pong)
+    locality_checked: bool = False
+    # one arg-prefetch kick per lease, AFTER it survives the locality
+    # check (prefetching before it would pull bytes for a lease about to
+    # spill to the node already holding them)
+    prefetched: bool = False
 
 
 class Raylet:
@@ -99,11 +116,36 @@ class Raylet:
         # GC'd dispatch kick leaves granted-but-unsent leases (raylint
         # RT003)
         self._held_tasks: set = set()
-        self._peer_conns: Dict[str, rpc.Connection] = {}
         self._actor_specs: Dict[bytes, bytes] = {}
         self.transfer = None               # native data-plane daemon
         self.transfer_port: Optional[int] = None
-        self._native_pulls = 0
+        # object plane: every inbound transfer funnels through the pull
+        # manager (dedup, inflight-bytes bound, chunked/native/rpc ladder)
+        self.pulls = PullManager(
+            node_id=self.node_id, session=session, shm=self.shm,
+            directory=self.directory,
+            get_view=lambda: self.cluster_view,
+            get_gcs=lambda: self.gcs,
+        )
+        # eviction/free of a secondary copy deregisters it from the GCS
+        # location table (listener fires on arbitrary threads, so the
+        # notify is trampolined onto the raylet loop)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.directory.evict_listener = self._on_objects_evicted
+        self._pushes_served = 0            # chunk ranges served to pullers
+        # outbound chunk pushes run on their own bounded pool, isolated
+        # from the pull manager's receiver waits — a local pull burst must
+        # never starve the pushes remote pullers are blocked on
+        self._push_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="rt-push"
+        )
+        self._m_locality = None            # (hits counter, misses counter)
+        # dispatch decision counters (exported as raylet_dispatch_* — the
+        # r4 lease-livelock was diagnosed from exactly these)
+        self._disp: Dict[str, int] = {
+            "grants": 0, "skipped_no_worker": 0,
+            "skipped_no_resources": 0, "done": 0, "seen": 0,
+        }
         # actor_id → (release token from _acquire_for-style accounting, demand)
         self._actor_resources: Dict[bytes, Tuple[object, ResourceSet]] = {}
         # conn → lease_ids it holds (reclaimed on disconnect; lease caching
@@ -142,6 +184,7 @@ class Raylet:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
+        self._loop = asyncio.get_running_loop()
         await self.server.start()
         tracing.get_buffer().set_identity(self.node_id, self.server.address)
         worker_env = dict(self.worker_env)
@@ -219,6 +262,8 @@ class Raylet:
     async def close(self):
         for t in self._bg:
             t.cancel()
+        self._push_pool.shutdown(wait=False, cancel_futures=True)
+        self.pulls.close()
         if getattr(self, "transfer", None):
             self.transfer.stop()
         if self.pool:
@@ -412,7 +457,7 @@ class Raylet:
     async def handle_request_lease(
         self, conn, resources, allow_spillback=True, pg_id=None,
         bundle_index=-1, req_id=None, task_id=None, task_name="",
-        trace_id=None,
+        trace_id=None, arg_hints=None,
     ):
         """Owner asks for a worker lease. Replies:
         {granted: worker_addr, lease_id} | {spillback: raylet_addr} |
@@ -439,6 +484,7 @@ class Raylet:
             task_id=task_id,
             task_name=task_name or "",
             trace_id=trace_id,
+            arg_hints=arg_hints or None,
         )
         self.pending_leases.append(lease)
         await self._dispatch()
@@ -452,6 +498,7 @@ class Raylet:
 
     async def handle_request_lease_batch(
         self, conn, resources, count, pg_id=None, bundle_index=-1,
+        arg_hints=None,
     ):
         """Batched lease requests (dispatch-plane batching): an owner whose
         scheduling key has backlog asks for `count` leases in ONE rpc
@@ -475,6 +522,7 @@ class Raylet:
                 pg_id=pg_id,
                 bundle_index=bundle_index,
                 owner_conn=conn,
+                arg_hints=arg_hints or None,
             ))
         self.pending_leases.extend(leases)
         await self._dispatch()
@@ -573,7 +621,8 @@ class Raylet:
             self.available = self.available.add(demand)
 
     def _spillback_target(self, demand: ResourceSet,
-                          require_available: bool = False) -> Optional[str]:
+                          require_available: bool = False,
+                          arg_hints=None) -> Optional[str]:
         views = []
         for nid, v in self.cluster_view.items():
             if nid == self.node_id or not v.get("alive"):
@@ -585,7 +634,15 @@ class Raylet:
                     available=ResourceSet(v["available"]),
                 )
             )
-        pick = hybrid_policy(demand, views)
+        if arg_hints:
+            # weigh resident-arg bytes against utilization: among peers
+            # that can run it NOW, the one already holding the largest
+            # args wins (scheduling_policy.locality_policy)
+            pick = locality_policy(
+                demand, views, arg_hints, _config.locality_weight
+            )
+        else:
+            pick = hybrid_policy(demand, views)
         if pick is None:
             if require_available:
                 # busy-node offload must target free capacity ONLY: falling
@@ -605,11 +662,6 @@ class Raylet:
         can never fit resolve via spillback/timeout without blocking others;
         fit-able leases grant FIFO as resources + idle workers allow."""
         now = time.monotonic()
-        # dispatch decision counters (exported as raylet_dispatch_* — the
-        # r4 lease-livelock was diagnosed from exactly these)
-        if not hasattr(self, "_disp"):
-            self._disp = {"grants": 0, "skipped_no_worker": 0,
-                          "skipped_no_resources": 0, "done": 0, "seen": 0}
         for lease in list(self.pending_leases):
             self._disp["seen"] += 1
             if lease.future.done():
@@ -621,7 +673,9 @@ class Raylet:
             )
             if never_fits_here:
                 if lease.allow_spillback:
-                    target = self._spillback_target(lease.demand)
+                    target = self._spillback_target(
+                        lease.demand, arg_hints=lease.arg_hints
+                    )
                     if target:
                         self.pending_leases.remove(lease)
                         lease.future.set_result({"spillback": target})
@@ -632,6 +686,24 @@ class Raylet:
                         {"infeasible": True, "reason": "no node can fit demand"}
                     )
                 continue
+            target = self._locality_target(lease)
+            if target is not None:
+                self._disp["locality_spillbacks"] = (
+                    self._disp.get("locality_spillbacks", 0) + 1
+                )
+                self.pending_leases.remove(lease)
+                lease.future.set_result({"spillback": target})
+                continue
+            if not lease.prefetched and (
+                    self._fits_now(lease)
+                    or now - lease.queued_at >= 0.5):
+                # start pulling remote args only once the lease is likely
+                # to GRANT here: resources fit now (just waiting on a
+                # worker), or it outlived the busy-node offload grace
+                # without a peer taking it. Prefetching earlier pulled
+                # bytes for leases the 0.5s offload then moved elsewhere.
+                lease.prefetched = True
+                self._prefetch_args(lease)
             idle = self.pool.idle_workers()
             if not idle:
                 self._disp["skipped_no_worker"] += 1
@@ -669,7 +741,8 @@ class Raylet:
                 # with free capacity NOW (never to another busy node)
                 if lease.allow_spillback and now - lease.queued_at >= 0.5:
                     target = self._spillback_target(
-                        lease.demand, require_available=True
+                        lease.demand, require_available=True,
+                        arg_hints=lease.arg_hints,
                     )
                     if target:
                         self.pending_leases.remove(lease)
@@ -680,6 +753,7 @@ class Raylet:
             worker.lease_id = lease.lease_id
             self.active_leases[lease.lease_id] = (lease.demand, worker, token)
             self._disp["grants"] += 1
+            self._record_locality(lease)
             self._observe_lease_grant(lease)
             if lease.pg_id is not None:
                 self._lease_pg[lease.lease_id] = (lease.pg_id, lease.bundle_index)
@@ -705,6 +779,108 @@ class Raylet:
         if cap <= 0:
             cap = max(4, int(self.total.get("CPU")) * 2)
         return cap
+
+    # ---------------------------------------------------- locality helpers
+    def _locality_target(self, lease: LeaseRequest) -> Optional[str]:
+        """Locality-preferred spillback: a feasible PEER already holding
+        strictly more of the lease's hinted arg bytes than this node takes
+        the lease (checked once per lease — the receiving raylet holds the
+        bytes, so it grants locally and there is no ping-pong)."""
+        if (not lease.arg_hints or not lease.allow_spillback
+                or lease.locality_checked
+                or _config.locality_weight <= 0):
+            return None
+        lease.locality_checked = True
+        # bytes on any SAME-SESSION node are local: its shm dir is ours
+        # (cluster_utils single-host clusters share one session), so a
+        # spillback there would pay a lease hop to save zero transfer
+        local = sum(
+            locality_score(lease.arg_hints, nid)
+            for nid in self._session_local_nodes()
+        )
+        best_nid, best = None, local
+        for nid, v in self.cluster_view.items():
+            if (nid == self.node_id or not v.get("alive")
+                    or v.get("session") == self.session):
+                continue
+            score = locality_score(lease.arg_hints, nid)
+            if score > best and ResourceSet(v["available"]).fits(lease.demand):
+                best_nid, best = nid, score
+        # only a CHUNK-sized advantage justifies a lease round-trip — for
+        # sub-pull_chunk_bytes args the transfer is cheaper than the hop
+        # (same significance threshold the owner's scheduling key uses)
+        if best_nid is None or best - local < _config.pull_chunk_bytes:
+            return None
+        return self.cluster_view[best_nid]["address"]
+
+    def _session_local_nodes(self) -> set:
+        """Node ids whose object bytes this node reads for free: itself
+        plus every alive peer sharing its shm session."""
+        out = {self.node_id}
+        for nid, v in self.cluster_view.items():
+            if v.get("alive") and v.get("session") == self.session:
+                out.add(nid)
+        return out
+
+    def _record_locality(self, lease: LeaseRequest) -> None:
+        """Grant-time proof counter: a hinted lease granted on the node
+        holding the most hinted bytes is a locality HIT (zero transfer for
+        its largest args), anything else a miss."""
+        if not lease.arg_hints:
+            return
+        session_local = self._session_local_nodes()
+        local = sum(
+            locality_score(lease.arg_hints, nid) for nid in session_local
+        )
+        best_remote = max(
+            (locality_score(lease.arg_hints, nid)
+             for nid, v in self.cluster_view.items()
+             if nid not in session_local and v.get("alive")),
+            default=0,
+        )
+        hit = local >= best_remote and local > 0
+        key = "locality_hits" if hit else "locality_misses"
+        self._disp[key] = self._disp.get(key, 0) + 1
+        if not _config.metrics_enabled:
+            return
+        if self._m_locality is None:
+            from ray_tpu.util import metrics as metrics_api
+
+            self._m_locality = (
+                metrics_api.Counter(
+                    "lease_locality_hits_total",
+                    "hinted leases granted on the node holding the most "
+                    "arg bytes",
+                ),
+                metrics_api.Counter(
+                    "lease_locality_misses_total",
+                    "hinted leases granted off the best arg-holding node",
+                ),
+            )
+        self._m_locality[0 if hit else 1].inc(1.0)
+
+    def _prefetch_args(self, lease: LeaseRequest) -> None:
+        """Arg prefetch: start pulling a queued lease's REMOTE hinted args
+        while the lease waits for resources/a worker, overlapping transfer
+        with scheduling delay (the worker otherwise pulls serially at
+        arg-decode time). Background priority: never ahead of a running
+        task's own arg pull."""
+        if not _config.arg_prefetch_enabled or not lease.arg_hints:
+            return
+        for oid_hex, nbytes, nid in lease.arg_hints:
+            if nid == self.node_id or not nbytes:
+                continue
+            peer = self.cluster_view.get(nid)
+            if (peer is None or not peer.get("alive")
+                    or peer.get("session") == self.session):
+                continue  # same session = same shm dir, nothing to move
+            oid = ObjectID.from_hex(oid_hex)
+            if self.shm.contains(oid):
+                continue
+            self._disp["prefetches"] = self._disp.get("prefetches", 0) + 1
+            self._hold(asyncio.ensure_future(self.pulls.pull(
+                oid, peer.get("address"), nbytes=nbytes, priority="prefetch",
+            )))
 
     def handle_return_lease(self, conn, lease_id):
         entry = self.active_leases.pop(lease_id, None)
@@ -1153,8 +1329,10 @@ class Raylet:
         return self.directory.stats()
 
     def handle_free_objects(self, conn, oids_hex):
-        for h in oids_hex:
-            self.directory.delete(ObjectID.from_hex(h))
+        oids = [ObjectID.from_hex(h) for h in oids_hex]
+        for oid in oids:
+            self.directory.delete(oid)
+        self._drop_secondaries(oids)
         return True
 
     async def handle_fetch_object(self, conn, oid_hex):
@@ -1179,74 +1357,103 @@ class Raylet:
         return rpc.Oob(buf.buffer, keepalive=buf)
 
     async def handle_pull_object(self, conn, oid_hex, source_addr,
-                                 nbytes=None):
+                                 nbytes=None, priority="arg",
+                                 transport=None):
         """Pull an object from a remote raylet into the local store.
 
-        Parity: PullManager/PushManager. Bulk bytes prefer the NATIVE data
-        plane — the peer's sendfile daemon streams the sealed shm file
-        directly into ours, bypassing the asyncio+pickle RPC path entirely
-        (src/ray/object_manager's C++ role). Falls back to the RPC fetch
-        when the peer runs without the native daemon."""
-        oid = ObjectID.from_hex(oid_hex)
-        if self.shm.contains(oid):
-            return True
-        if self.directory.restore(oid):
-            return True
-        n = await self._native_pull(oid, oid_hex, source_addr, nbytes)
-        if n is not None:
-            self.directory.add(oid, n)
-            return True
-        peer = self._peer_conns.get(source_addr)
-        if peer is None or peer.closed:
-            try:
-                peer = await rpc.connect(source_addr, handler=self, retries=3)
-            except rpc.ConnectionLost:
-                return False
-            self._peer_conns[source_addr] = peer
-        try:
-            data = await peer.call("fetch_object", oid_hex=oid_hex, timeout=60)
-        except (rpc.RpcError, rpc.ConnectionLost):
-            return False
-        if data is None:
-            return False
-        data = rpc.unwrap_oob(data)  # zero-copy view over the reply frame
-        n = data.nbytes if isinstance(data, memoryview) else len(data)
-        self.directory.ensure_capacity(n)
-        self.shm.put_bytes(oid, data)
-        self.directory.add(oid, n)
-        return True
-
-    async def _native_pull(self, oid, oid_hex: str, source_addr: str,
-                           nbytes=None):
-        """Stream via the peer's sendfile daemon; returns byte count or
-        None (daemon unknown/unreachable → caller falls back to RPC)."""
-        port = None
-        for v in self.cluster_view.values():
-            if v.get("address") == source_addr:
-                if not v.get("alive"):
-                    return None
-                port = v.get("transfer_port")
-                break
-        if not port:
-            return None
-        if nbytes and not self.directory.ensure_capacity(nbytes):
-            return None  # store full even after eviction
-        from ray_tpu.core.object_store import native as native_mod
-
-        host = source_addr.rsplit(":", 1)[0]
-        dest = self.shm._path(oid)
-        token = rpc.get_auth_token() or "none"
-        n = await asyncio.get_event_loop().run_in_executor(
-            None, native_mod.fetch_to_file, host, port, token, oid_hex, dest,
+        Parity: PullManager/PushManager — all inbound transfers funnel
+        through ``self.pulls`` (dedup, inflight-bytes bound with task-arg
+        priority, chunked stream-plane transfer with native-daemon and rpc
+        fallbacks, typed capacity refusal). Replies
+        ``{"ok": True}`` / ``{"ok": False, "reason": ...}``."""
+        return await self.pulls.pull(
+            ObjectID.from_hex(oid_hex), source_addr, nbytes=nbytes,
+            priority=priority, transport=transport,
         )
-        if n is not None:
-            if not nbytes:
-                self.directory.ensure_capacity(n)
-            self._native_pulls += 1
-        return n
+
+    async def handle_push_chunks(self, conn, oid_hex, indices, nbytes,
+                                 chunk_bytes, host, port, channel_id, token):
+        """Source side of a chunked pull: stream the requested chunk
+        indices of a locally-sealed object to the puller's ChunkReceiver
+        (object_store/chunk_transfer.py). The transfer runs on an executor
+        thread with the ShmBuffer pinned; the reply only acknowledges that
+        the push STARTED — completion is the puller's receiver seeing its
+        chunks land (a severed stream surfaces there as a missing set)."""
+        oid = ObjectID.from_hex(oid_hex)
+        buf = self.shm.get(oid)
+        if buf is None:
+            if not self.directory.restore(oid):
+                return {"ok": False, "reason": "not local"}
+            buf = self.shm.get(oid)
+            if buf is None:
+                return {"ok": False, "reason": "not local"}
+        self.directory.touch(oid)
+        self._pushes_served += 1
+        from ray_tpu.core.object_store import chunk_transfer
+
+        def _push_and_release():
+            try:
+                chunk_transfer.push_chunks_blocking(
+                    buf, oid_hex, indices, nbytes, chunk_bytes, host, port,
+                    channel_id, token,
+                )
+            finally:
+                buf.close()
+
+        self._hold(asyncio.ensure_future(
+            asyncio.get_running_loop().run_in_executor(
+                self._push_pool, _push_and_release
+            )
+        ))
+        return {"ok": True}
+
+    def _on_objects_evicted(self, oids) -> None:
+        """Directory eviction listener (arbitrary thread, lock released):
+        deregister evicted SECONDARY copies from the GCS location table so
+        no puller is ever routed to a holder that just dropped its copy."""
+        self._drop_secondaries(oids)
+
+    def _drop_secondaries(self, oids) -> None:
+        """Single teardown path for vanished local copies (free, evict):
+        forget them in the pull manager and deregister them at the GCS.
+        Callable from ANY thread — the notify is trampolined onto the
+        raylet loop (call_soon_threadsafe is loop-thread-safe too)."""
+        gone = self.pulls.on_local_drop(oids)
+        if not gone or self._loop is None:
+            return
+        entries = [(oid.hex(), self.node_id) for oid in gone]
+        self._loop.call_soon_threadsafe(
+            lambda: self._hold(asyncio.ensure_future(
+                self._deregister_locations(entries)
+            ))
+        )
+
+    async def _deregister_locations(self, entries) -> None:
+        if self.gcs is None or self.gcs.closed:
+            return
+        try:
+            await self.gcs.notify("object_location_remove", entries=entries)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            pass  # soft state; the GCS prunes dead nodes itself
 
     def handle_object_store_stats(self, conn):
         return self.directory.stats()
+
+    def handle_scheduler_stats(self, conn):
+        """Introspection for tests/CLI: dispatch decision counters
+        (including locality hits/misses and prefetch kicks), pull-manager
+        transport stats, and chunk ranges served to peers."""
+        return {
+            "dispatch": dict(self._disp),
+            "pulls": dict(self.pulls.stats),
+            "pushes_served": self._pushes_served,
+            # this raylet's OWN gossiped view (what locality decisions see)
+            "view": {
+                nid: dict(v.get("available") or {})
+                for nid, v in self.cluster_view.items()
+                if v.get("alive")
+            },
+        }
 
     async def on_disconnection(self, conn):
         """An owner's connection dropped: reclaim every lease it still

@@ -59,6 +59,49 @@ def hybrid_policy(
     return rng.choice(ranked[:k]).node_id
 
 
+def locality_score(arg_hints: Optional[Sequence], node_id: str) -> int:
+    """Bytes of hinted task args resident on ``node_id``.
+
+    ``arg_hints`` is the lease request's ``[(oid_hex, nbytes, node_id)]``
+    list (owner-recorded locations of the task's by-reference args); the
+    score is what a lease granted on ``node_id`` would NOT have to pull."""
+    if not arg_hints:
+        return 0
+    return sum(int(nb) for (_oid, nb, nid) in arg_hints if nid == node_id)
+
+
+def locality_policy(
+    demand: ResourceSet,
+    nodes: Sequence[NodeView],
+    arg_hints: Optional[Sequence],
+    locality_weight: float,
+) -> Optional[str]:
+    """Pick a node for a lease whose request carries arg-locality hints.
+
+    Candidates (alive, available-fit) are ranked by
+    ``utilization - locality_weight * resident_fraction`` — packing still
+    matters, but a feasible node already holding the largest args wins
+    ties (and outright wins while the weight outruns the utilization
+    spread). Falls back to :func:`hybrid_policy` when hints are empty or
+    the weight is zero. Deterministic: no top-k sampling — two raylets
+    ranking the same view must agree, or a lease ping-pongs."""
+    if not arg_hints or locality_weight <= 0:
+        return hybrid_policy(demand, nodes)
+    total = sum(int(nb) for (_o, nb, _n) in arg_hints) or 1
+    avail = [n for n in nodes if n.alive and n.available.fits(demand)]
+    if not avail:
+        return None
+    ranked = sorted(
+        avail,
+        key=lambda n: (
+            n.utilization()
+            - locality_weight * (locality_score(arg_hints, n.node_id) / total),
+            n.node_id,
+        ),
+    )
+    return ranked[0].node_id
+
+
 def spread_policy(
     demand: ResourceSet,
     nodes: Sequence[NodeView],

@@ -495,6 +495,17 @@ class WriterState:
         no credits (``max_msgs`` unconsumed messages are already in flight).
         Returns ``(bytes_sent, credit_stall_seconds)``."""
         payload, bufs = dumps_oob(obj)
+        return self.send_frame(payload, bufs, timeout=timeout)
+
+    def send_frame(self, payload: bytes, bufs: List[Any],
+                   timeout: Optional[float] = None) -> Tuple[int, float]:
+        """Send one pre-serialized DATA frame (payload + out-of-band
+        buffers written straight from their source memory). The raw-frame
+        twin of :meth:`send_obj` — the object-plane chunk protocol rides
+        this with a struct header payload and the chunk's mmap slice as
+        the single buffer, skipping pickle entirely."""
+        bufs = [b if isinstance(b, memoryview) else memoryview(b)
+                for b in bufs]
         deadline = None if timeout is None else time.monotonic() + timeout
         stall = 0.0
         with self._cond:

@@ -166,6 +166,12 @@ async function slo() {
   if (tq.length) html += spark("task p99", tq, "ms");
   const depth = pts(samples, "raylet_pending_leases").map(p => p.v);
   if (depth.length) html += spark("sched queue", depth, "");
+  // object plane (PR 15): pull-transfer throughput + in-flight bytes
+  const xfer = rate(pts(samples, "object_transfer_bytes_total"))
+    .map(v => v / 1e6);
+  if (xfer.length) html += spark("transfer", xfer, "MB/s");
+  const pin = pts(samples, "pull_inflight_bytes").map(p => p.v / 1e6);
+  if (pin.length) html += spark("pull inflight", pin, "MB");
   document.getElementById("slo").innerHTML =
     html || "(no SLO series yet)";
 }

@@ -56,6 +56,11 @@ EXPECTED_ROWS: List[str] = [
     "dag cross-node interpreted execute (2 nodes)",
     "dag cross-node compiled execute (2 nodes)",
     "dag cross-node compiled (pipelined, 2 nodes)",
+    "object pull monolithic rpc (MB/s)",
+    "object pull chunked stream (MB/s)",
+    "object pull chunked/rpc ratio",
+    "object pull striped 2-source (MB/s)",
+    "object broadcast 4 pullers (origin serves)",
 ]
 
 
@@ -262,6 +267,9 @@ def main(duration: float = 2.0, json_path: str = "", smoke: bool = False):
     # ------------------------------------------------- cross-node cgraph
     _cross_node_benchmarks(ray_tpu, results, duration)
 
+    # ----------------------------------------------------- object plane
+    _object_plane_benchmarks(ray_tpu, results, smoke)
+
     payload = {"microbenchmark": results}
     print(json.dumps(payload))
     if json_path:
@@ -346,6 +354,153 @@ def _cross_node_benchmarks(ray_tpu, results, duration: float):
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def _object_plane_benchmarks(ray_tpu, results, smoke: bool = False):
+    """Object-plane transfer (PR 15): a 64 MiB object pulled between
+    raylets with SPLIT shm sessions (genuine cross-node bytes), comparing
+    the monolithic rpc fetch against the chunked stream-plane pull, a
+    striped 2-source pull, and a 4-puller broadcast whose later pullers
+    fetch from registered secondary copies (origin serve count < N)."""
+    import os
+    import shutil
+    import uuid
+
+    from ray_tpu.core.cluster_backend import (
+        ProcessGroup,
+        _session_tmp_dir,
+        start_gcs,
+        start_raylet,
+    )
+    from ray_tpu.core.object_store.shm_store import session_dir
+
+    size = (4 if smoke else 64) * 1024 * 1024
+    ray_tpu.shutdown()
+    # stripe even the smoke-sized object; daemons read this at spawn
+    saved_env = os.environ.get("RAY_TPU_PULL_STRIPE_MIN_BYTES")
+    os.environ["RAY_TPU_PULL_STRIPE_MIN_BYTES"] = str(2 * 1024 * 1024)
+    sessions = []
+    procs = ProcessGroup(_session_tmp_dir(f"s{uuid.uuid4().hex[:10]}"))
+    gcs = start_gcs(procs)
+    pullers = [f"pull{i}" for i in range(4)]
+    for name in ["origin"] + pullers:
+        session = f"s{uuid.uuid4().hex[:10]}"
+        sessions.append(session)
+        start_raylet(procs, gcs, session, name, num_cpus=1, num_tpus=0)
+    ray_tpu.init(address=gcs, _node_name="origin")
+    try:
+        from ray_tpu.api import _global_worker
+
+        core = _global_worker().backend.core
+        origin_addr = core.raylet_address
+
+        async def _view():
+            return await core.gcs.call("get_resource_view", timeout=30)
+
+        # all five raylets must be registered before we dial them by name
+        deadline = time.perf_counter() + 60
+        while True:
+            addr = {
+                nid: v["address"]
+                for nid, v in core.io.run(_view(), timeout=60).items()
+            }
+            if {"origin", *pullers} <= set(addr):
+                break
+            if time.perf_counter() > deadline:
+                raise RuntimeError(f"raylets never registered: {sorted(addr)}")
+            time.sleep(0.2)
+        blob = np.random.default_rng(0).integers(
+            0, 255, size=size, dtype=np.uint8
+        )
+
+        def _put():
+            ref = ray_tpu.put(blob)
+            return ref, ref.id
+
+        async def _pull(node, oid, transport):
+            conn = await core._conn_to(addr[node], kind="raylet")
+            return await conn.call(
+                "pull_object", oid_hex=oid.hex(), source_addr=origin_addr,
+                nbytes=size, transport=transport, timeout=600,
+            )
+
+        async def _free(nodes, oid):
+            for node in nodes:
+                conn = await core._conn_to(addr[node], kind="raylet")
+                await conn.call(
+                    "free_objects", oids_hex=[oid.hex()], timeout=30
+                )
+
+        async def _stats(node):
+            conn = await core._conn_to(addr[node], kind="raylet")
+            return await conn.call("scheduler_stats", timeout=30)
+
+        def timed_pull(node, transport, seed_nodes=()):
+            ref, oid = _put()
+            for seed in seed_nodes:  # pre-place secondary copies
+                reply = core.io.run(_pull(seed, oid, None), timeout=600)
+                assert reply.get("ok"), reply
+            t0 = time.perf_counter()
+            reply = core.io.run(_pull(node, oid, transport), timeout=600)
+            dt = time.perf_counter() - t0
+            assert reply.get("ok"), reply
+            core.io.run(_free([node, *seed_nodes], oid), timeout=120)
+            del ref
+            return size / dt / 1e6
+
+        def rate_row(name, transport, seed_nodes=()):
+            rates = sorted(
+                timed_pull("pull0", transport, seed_nodes)
+                for _ in range(1 if smoke else 3)
+            )
+            val = rates[len(rates) // 2]
+            print(f"{name:<50s} {val:>10.1f} MB/s")
+            results.append({"name": name, "mb_per_s": round(val, 1)})
+            return val
+
+        rpc_rate = rate_row("object pull monolithic rpc (MB/s)", "rpc")
+        chunked_rate = rate_row(
+            "object pull chunked stream (MB/s)", "chunked"
+        )
+        ratio = chunked_rate / max(rpc_rate, 1e-9)
+        print(f"{'object pull chunked/rpc ratio':<50s} {ratio:>11.2f}x")
+        results.append({
+            "name": "object pull chunked/rpc ratio", "ratio": round(ratio, 2),
+        })
+        rate_row(
+            "object pull striped 2-source (MB/s)", "chunked",
+            seed_nodes=("pull1",),
+        )
+
+        # broadcast: 4 pullers of ONE object, sequential — later pullers
+        # must fetch from registered secondary copies, not the origin
+        before = core.io.run(_stats("origin"), timeout=60)["pushes_served"]
+        ref, oid = _put()
+        for node in pullers:
+            reply = core.io.run(_pull(node, oid, "chunked"), timeout=600)
+            assert reply.get("ok"), reply
+        origin_serves = (
+            core.io.run(_stats("origin"), timeout=60)["pushes_served"] - before
+        )
+        assert origin_serves < len(pullers), (
+            f"no secondary-copy serving: origin served {origin_serves}/"
+            f"{len(pullers)} pulls"
+        )
+        name = "object broadcast 4 pullers (origin serves)"
+        print(f"{name:<50s} {origin_serves:>6d}/{len(pullers)}")
+        results.append({
+            "name": name, "origin_serves": origin_serves,
+            "pullers": len(pullers),
+        })
+    finally:
+        ray_tpu.shutdown()
+        procs.shutdown()
+        for s in sessions:
+            shutil.rmtree(session_dir(s), ignore_errors=True)
+        if saved_env is None:
+            os.environ.pop("RAY_TPU_PULL_STRIPE_MIN_BYTES", None)
+        else:
+            os.environ["RAY_TPU_PULL_STRIPE_MIN_BYTES"] = saved_env
 
 
 def _chunk_source(n):

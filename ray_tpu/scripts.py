@@ -236,6 +236,24 @@ def render_metrics_snapshot(samples) -> str:
     if overload:
         lines.append("")
         lines.append("overload: " + "  ".join(overload))
+    # object plane: pull-transfer throughput + locality hit rate (the
+    # PR-15 series — a hot owner node shows here as transfer MB/s with a
+    # low locality hit rate)
+    transfer = []
+    rate = counter_rate(samples, "object_transfer_bytes_total")
+    if rate is not None and rate > 0:
+        transfer.append(f"transfer={rate / 1e6:,.1f} MB/s")
+    for label, metric in (
+        ("locality-hits/s", "lease_locality_hits_total"),
+        ("locality-misses/s", "lease_locality_misses_total"),
+        ("stream-spills/s", "streaming_spilled_items_total"),
+    ):
+        r = counter_rate(samples, metric)
+        if r is not None and r > 0:
+            transfer.append(f"{label}={r:,.2f}")
+    if transfer:
+        lines.append("")
+        lines.append("object plane: " + "  ".join(transfer))
     # dev-mode sanitizer trips anywhere in the cluster (daemon processes
     # flush the counter to the GCS like any other metric) — a lock-order
     # cycle or io-loop stall in production is an incident, surface it
@@ -252,6 +270,7 @@ def render_metrics_snapshot(samples) -> str:
         "raylet_pending_leases", "raylet_active_leases",
         "object_store_used_bytes", "object_store_num_objects",
         "streaming_owner_buffered_items",
+        "pull_inflight_bytes", "pull_queue_depth",
     )
     gauges = []
     for name in gauge_names:

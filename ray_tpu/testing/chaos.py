@@ -42,6 +42,10 @@ point                 where it fires
 ``gcs.wal``           ``core/gcs/wal.py`` append — the GCS hard-exits
                       right after the Nth durable WAL record lands
                       (mutation durable, reply unsent; no pre-exit flush)
+``object.pull``       ``core/object_store/chunk_transfer.py`` push loop —
+                      the source severs a chunked pull's stream before the
+                      Nth chunk; the puller resumes the missing chunks
+                      from another holder
 ====================  ======================================================
 
 Usage (context-manager API)::
@@ -144,6 +148,14 @@ REGISTERED_POINTS: Dict[str, Dict[str, Any]] = {
                  "matching calls are delayed — deterministic slow-replica "
                  "injection driving the circuit breaker",
     },
+    "object.pull": {
+        "module": "ray_tpu/core/object_store/chunk_transfer.py",
+        "builders": ["sever_pull"],
+        "where": "chunked object transfer: the source severs the chunk "
+                 "stream right before sending the Nth chunk, so the "
+                 "puller must resume the missing chunks from another "
+                 "holder (or re-dial) with byte-identical content",
+    },
     "gcs.wal": {
         "module": "ray_tpu/core/gcs/wal.py",
         "builders": ["kill_gcs_at_wal"],
@@ -227,6 +239,16 @@ class ChaosPlan:
         kill, then a typed ActorDiedError/WorkerCrashedError on the next
         item — never a hang or a silent end-of-stream."""
         return self._rule("stream.yield", "kill", match=match, nth=after_items)
+
+    def sever_pull(self, match: str = "", after_chunks: int = 1) -> "ChaosPlan":
+        """Sever a chunked object pull's stream connection right before
+        the source sends the Nth chunk whose object id contains ``match``
+        (empty = any pull). The puller's receiver observes a mid-stream
+        loss and the pull manager must resume exactly the missing chunks —
+        against another holder when one exists — never restart from zero,
+        never hang, and the sealed object must be byte-identical."""
+        return self._rule("object.pull", "sever", match=match,
+                          nth=after_chunks)
 
     def sever_channel(self, match: str = "", nth: int = 1) -> "ChaosPlan":
         """Sever a cross-node compiled-graph channel's stream connection at

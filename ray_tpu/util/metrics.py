@@ -161,6 +161,17 @@ KNOWN_METRICS: Dict[str, str] = {
     "object_store_used_bytes": "bytes sealed in the local shm store",
     "object_store_num_objects": "objects in the local shm store",
     "object_store_num_spilled": "objects spilled to disk",
+    # object plane: pull-based transfer + locality scheduling
+    "object_transfer_bytes_total": "object bytes pulled into this node's "
+                                   "store",
+    "pull_inflight_bytes": "bytes of concurrently-executing object pulls",
+    "pull_queue_depth": "pulls parked behind the in-flight bytes bound",
+    "lease_locality_hits_total": "hinted leases granted on the node "
+                                 "holding the most arg bytes",
+    "lease_locality_misses_total": "hinted leases granted off the best "
+                                   "arg-holding node",
+    "streaming_spilled_items_total": "overflowing stream items spilled to "
+                                     "the shm store",
     # cgraph / transport / streaming
     "cgraph_execute_ms": "compiled-graph execute -> first get",
     "channel_bytes_sent": "bytes over cross-node cgraph channels",

@@ -32,6 +32,11 @@ def split_session_cluster():
     )
 
     ray_tpu.shutdown()
+    # the chunked stream-plane pull (PR 15) outranks the native daemon by
+    # default; this suite covers the DAEMON fallback, so pin it off in the
+    # raylets spawned below
+    saved = os.environ.get("RAY_TPU_PULL_CHUNKED_ENABLED")
+    os.environ["RAY_TPU_PULL_CHUNKED_ENABLED"] = "0"
     session_a = f"s{uuid.uuid4().hex[:10]}"
     session_b = f"s{uuid.uuid4().hex[:10]}"
     procs = ProcessGroup(_session_tmp_dir(session_a))
@@ -47,6 +52,10 @@ def split_session_cluster():
     finally:
         ray_tpu.shutdown()
         procs.shutdown()
+        if saved is None:
+            os.environ.pop("RAY_TPU_PULL_CHUNKED_ENABLED", None)
+        else:
+            os.environ["RAY_TPU_PULL_CHUNKED_ENABLED"] = saved
         from ray_tpu.core.object_store.shm_store import session_dir
 
         for s in (session_a, session_b):
