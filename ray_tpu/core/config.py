@@ -102,6 +102,19 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     object_spilling_dir: str = ""
     object_store_full_delay_ms: int = 100
+    # --- object lifecycle (object_store/lifecycle.py, shm_store.py) ---------
+    # proactive spill: a raylet background loop spills cold PRIMARY copies
+    # to the session spill dir once in-memory use crosses this fraction of
+    # capacity, so eviction under pressure is a cheap unlink and a node
+    # death leaves disk copies behind for a survivor to adopt
+    object_spill_threshold_frac: float = 0.8
+    object_spill_interval_s: float = 1.0
+    # owner pin leases: owners renew pins on the raylets holding their
+    # primaries every renew interval; the raylet grants each renewal this
+    # TTL. A pinned primary may be spilled but is never dropped by
+    # pressure; a crashed owner's pins simply age out (ttl >> renew).
+    object_pin_ttl_s: float = 30.0
+    object_pin_renew_interval_s: float = 5.0
 
     # --- object plane: pull-based transfer (object_store/pull_manager.py) ---
     # chunked pulls over the stream transport: big objects cross nodes as
@@ -194,6 +207,10 @@ class Config:
     # pending (the old lossy 1s _snapshot_loop cadence, now only a bound on
     # replay length rather than on durability)
     gcs_snapshot_interval_s: float = 15.0
+    # graceful close writes its final snapshot through the compaction
+    # executor (never synchronously on the event loop) and waits at most
+    # this long; on timeout the WAL alone carries the acknowledged state
+    gcs_close_snapshot_timeout_s: float = 10.0
     # raylet -> GCS task-event WAL tail shipping (whole-node-loss
     # forensics): how often each raylet ships its workers' unflushed WAL
     # tails, and the per-worker byte bound on one shipment

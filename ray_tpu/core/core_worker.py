@@ -344,6 +344,7 @@ class CoreWorker:
         for loop_coro in (
             self._flush_task_events_loop(), self._metrics_flush_loop(),
             self._gcs_watchdog(), self._lease_reaper_loop(),
+            self._pin_renew_loop(),
         ):
             self._hold_bg(asyncio.ensure_future(loop_coro))
 
@@ -768,6 +769,14 @@ class CoreWorker:
                     await conn.call_batched(
                         "free_objects", oids_hex=items, timeout=30
                     )
+            elif kind == "pin":
+                # owner → holder raylet: renew the pin lease on primaries
+                # this worker still holds live references to (the raylet
+                # applies its configured TTL; a crashed owner simply stops
+                # renewing and the pins age out)
+                conn = await self._conn_to(target, kind="raylet")
+                if conn is not None:
+                    await conn.notify_batched("pin_objects", entries=items)
             elif kind == "release_borrow":
                 conn = await self._conn_to(target, kind="worker")
                 if conn is not None:
@@ -778,6 +787,26 @@ class CoreWorker:
             pass
         except Exception:  # noqa: BLE001 - bookkeeping must never kill io
             logger.exception("metadata batch flush failed (%s)", kind)
+
+    async def _pin_renew_loop(self) -> None:
+        """Owner side of primary pinning: every renew interval, queue a
+        batched pin renewal to each raylet holding a primary this worker
+        owns live references to. Rides the same metadata batch plane as
+        object_added/free — one rpc per raylet per flush, nothing on the
+        put/get hot paths. When this process dies the renewals stop and
+        the raylet-side leases expire, so pins can never wedge eviction."""
+        period = max(0.2, _config.object_pin_renew_interval_s)
+        while True:
+            await asyncio.sleep(period)
+            try:
+                for oid, loc in list(self.locations.items()):
+                    if oid.binary() not in self._owned:
+                        continue
+                    addr = (loc or {}).get("raylet_addr")
+                    if addr:
+                        self._queue_meta("pin", addr, oid.hex())
+            except Exception:  # noqa: BLE001 - bookkeeping must never kill io
+                logger.exception("pin renewal sweep failed")
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not self.events.enabled():
@@ -928,7 +957,8 @@ class CoreWorker:
         except (rpc.RpcError, rpc.ConnectionLost):
             return None
 
-    async def _read_location(self, oid: ObjectID, loc: Optional[dict]):
+    async def _read_location(self, oid: ObjectID, loc: Optional[dict],
+                             _survivor_probe: bool = True):
         if loc is None:
             return exc.ObjectLostError(oid, "no location")
         if loc["session"] == self.session:
@@ -952,6 +982,7 @@ class CoreWorker:
                     source_addr=loc["raylet_addr"],
                     nbytes=loc.get("nbytes"),
                     priority="arg",
+                    job_id=self.job_id or tracing.current_job_id(),
                     timeout=timeout + 30,
                 )
             except (rpc.RpcError, rpc.ConnectionLost):
@@ -972,7 +1003,40 @@ class CoreWorker:
                     return rpc.unwrap_oob(data)
             except (rpc.RpcError, rpc.ConnectionLost):
                 pass
+        # the recorded holder is gone: the GCS death path may have promoted
+        # a surviving secondary (or adopted a spill file) — retry ONCE
+        # against a survivor before falling back to lineage reconstruction
+        if _survivor_probe:
+            alt = await self._survivor_location(oid, loc.get("raylet_addr"))
+            if alt is not None:
+                if oid in self.locations:
+                    self.locations[oid] = alt  # re-anchor for later gets
+                return await self._read_location(oid, alt,
+                                                 _survivor_probe=False)
         return exc.ObjectLostError(oid, "object unavailable on all nodes")
+
+    async def _survivor_location(self, oid: ObjectID,
+                                 failed_addr: Optional[str]):
+        """Ask the GCS location table for a holder other than the one that
+        just failed (dead-node recovery: secondary promotion / spill
+        adoption re-registers survivors there)."""
+        if self.gcs is None or self.gcs.closed:
+            return None
+        try:
+            holders = await self.gcs.call(
+                "object_locations", oid_hex=oid.hex(), timeout=10
+            )
+        except (rpc.RpcError, rpc.ConnectionLost):
+            return None
+        for h in holders or []:
+            if h.get("address") and h["address"] != failed_addr:
+                return {
+                    "session": h.get("session"),
+                    "raylet_addr": h["address"],
+                    "node_id": h.get("node_id"),
+                    "nbytes": h.get("nbytes"),
+                }
+        return None
 
     async def _conn_to(self, addr: Optional[str], kind: str):
         if addr is None:

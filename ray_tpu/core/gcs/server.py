@@ -184,13 +184,16 @@ class GcsServer:
         self.channel_endpoints: Dict[str, dict] = {}
         self._endpoint_events: Dict[str, asyncio.Event] = {}
         # object plane: secondary-copy directory (oid_hex -> {node_id:
-        # nbytes}, insertion-ordered). Raylets register here after a
-        # completed pull and deregister on eviction/free, so later pullers
-        # of a hot object fetch from a spread of holders (distribution
-        # tree) instead of hammering the owner node. Soft state by design:
+        # {"nbytes", "spill"}}, insertion-ordered). Raylets register here
+        # after a completed pull, register spill-file metadata (path,
+        # nbytes, crc) when they spill, and deregister on eviction/free,
+        # so later pullers of a hot object fetch from a spread of holders
+        # (distribution tree) instead of hammering the owner node — and
+        # the node-death path can promote a surviving holder or hand a
+        # dead raylet's spill file to a live one. Soft state by design:
         # not snapshotted/WAL'd — after a GCS restart pulls fall back to
         # the owner-recorded primary location and the table re-fills.
-        self.object_locations: Dict[str, Dict[str, int]] = {}
+        self.object_locations: Dict[str, Dict[str, dict]] = {}
         self._object_loc_rr: Dict[str, int] = {}
 
     # ------------------------------------------------------------ lifecycle
@@ -218,10 +221,37 @@ class GcsServer:
         for t in self._bg:
             t.cancel()
         if self.store_path:
-            self._write_snapshot()
+            await self._close_snapshot()
         if self.wal is not None:
             self.wal.close()
         await self.server.close()
+
+    async def _close_snapshot(self) -> None:
+        """Final snapshot on graceful close: same shape as a compaction —
+        rotate + durable-table capture on the loop, heavy copy-outs +
+        pickle + prune on the executor — but with a bounded wait instead
+        of blocking the event loop synchronously. On timeout the sealed
+        WAL segments still hold every acknowledged mutation, so nothing
+        is lost; the next start just replays a longer log."""
+        self._snap_gen += 1
+        gen = self._snap_gen
+        seq = self.wal.rotate() if self.wal is not None else 0
+        state = self._snapshot_state(seq, include_heavy=False)
+
+        def write():
+            self._snapshot_heavy(state)
+            self._install_snapshot(gen, state, seq)
+
+        try:
+            await asyncio.wait_for(
+                asyncio.get_event_loop().run_in_executor(None, write),
+                timeout=max(0.1, _config.gcs_close_snapshot_timeout_s),
+            )
+        except asyncio.TimeoutError:
+            logger.warning(
+                "close-time snapshot exceeded %.1fs; relying on the WAL",
+                _config.gcs_close_snapshot_timeout_s,
+            )
 
     # --------------------------------------------------- fault tolerance
     def _wal_base(self) -> str:
@@ -414,8 +444,9 @@ class GcsServer:
         state["task_events"] = self.task_events.dump()
 
     def _write_snapshot(self) -> None:
-        """Synchronous snapshot (graceful close path); the running server
-        compacts through _compaction_loop instead."""
+        """Synchronous full snapshot (tests / offline tooling); the running
+        server compacts through _compaction_loop and graceful close goes
+        through _close_snapshot (bounded, off-loop)."""
         self._snap_gen += 1
         gen = self._snap_gen
         seq = self.wal.rotate() if self.wal is not None else 0
@@ -707,19 +738,82 @@ class GcsServer:
         # that host — ingest the tails it shipped here while alive, closing
         # the dead workers' timelines (idempotent wal- source dedup)
         self._ingest_shipped_wals(node.node_id)
-        # a dead node serves no object copies: drop its directory entries
-        # so pullers never stripe against a ghost holder
+        # dead-node object recovery: a dead node serves no copies, so for
+        # every object it held either promote a surviving holder's
+        # SECONDARY to PRIMARY, or — when no in-memory copy survives but
+        # the dead raylet registered spill metadata — hand its spill file
+        # to a live raylet (same-host adoption). With neither, the entry
+        # drops and the owner's get() falls back to lineage
+        # reconstruction instead of hanging on a ghost holder.
+        promote: Dict[str, list] = {}  # survivor node_id -> [oid_hex]
+        orphans: list = []             # (oid_hex, spill metadata)
         for oid_hex in list(self.object_locations):
             holders = self.object_locations[oid_hex]
-            holders.pop(node.node_id, None)
+            dead = holders.pop(node.node_id, None)
             if not holders:
                 self.object_locations.pop(oid_hex, None)
                 self._object_loc_rr.pop(oid_hex, None)
+            if dead is None:
+                continue
+            if holders:
+                survivor = next(
+                    (nid for nid in holders
+                     if (n := self.nodes.get(nid)) is not None and n.alive),
+                    None,
+                )
+                if survivor is not None:
+                    promote.setdefault(survivor, []).append(oid_hex)
+            elif isinstance(dead, dict) and dead.get("spill"):
+                orphans.append((oid_hex, dead["spill"]))
+        await self._reassign_object_copies(node, promote, orphans)
         await self.publish("node", {"event": "dead", "node_id": node.node_id})
         # fail over actors on that node
         for actor in list(self.actors.values()):
             if actor.node_id == node.node_id and actor.state in (ALIVE, PENDING):
                 await self._on_actor_failure(actor, f"node {node.node_id} died")
+
+    async def _reassign_object_copies(self, dead_node, promote: dict,
+                                      orphans: list) -> None:
+        """Execute the death-path object reassignments computed by
+        _on_node_dead: promotion rpcs to surviving holders, and spill-file
+        adoption by one live raylet (re-registered here on success)."""
+        for nid, oids in promote.items():
+            n = self.nodes.get(nid)
+            if n is None or n.conn is None:
+                continue
+            try:
+                await n.conn.call("promote_primary", oids_hex=oids,
+                                  timeout=10)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                pass  # the copy still serves; promotion is advisory
+        if not orphans:
+            return
+        adopter = next(
+            (n for n in self.nodes.values()
+             if n.alive and n.conn is not None
+             and n.node_id != dead_node.node_id),
+            None,
+        )
+        if adopter is None:
+            return
+        entries = [(oid_hex, sp.get("path"), sp.get("nbytes"), sp.get("crc"))
+                   for oid_hex, sp in orphans]
+        try:
+            adopted = await adopter.conn.call("adopt_spill", entries=entries,
+                                              timeout=30)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            adopted = []
+        adopted = set(adopted or [])
+        for oid_hex, sp in orphans:
+            if oid_hex in adopted:
+                self.object_locations.setdefault(oid_hex, {})[
+                    adopter.node_id
+                ] = {"nbytes": int(sp.get("nbytes") or 0), "spill": dict(sp)}
+        if adopted:
+            logger.warning(
+                "node %s died: %d spilled objects adopted by %s",
+                dead_node.node_id, len(adopted), adopter.node_id,
+            )
 
     def _ingest_shipped_wals(self, node_id: str) -> int:
         tails = self.node_wal_tails.pop(node_id, None)
@@ -745,8 +839,25 @@ class GcsServer:
     # ----------------------------------------------------------------- kv
     # ------------------------------------------- object-location directory
     def handle_object_location_add(self, conn, oid_hex, node_id, nbytes):
-        """A raylet completed a pull: record it as a secondary holder."""
-        self.object_locations.setdefault(oid_hex, {})[node_id] = int(nbytes)
+        """A raylet completed a pull: record it as a secondary holder
+        (spill metadata, if this holder spilled earlier, is preserved)."""
+        slot = self.object_locations.setdefault(oid_hex, {}).setdefault(
+            node_id, {"nbytes": 0, "spill": None}
+        )
+        slot["nbytes"] = int(nbytes)
+        return True
+
+    def handle_object_location_spill(self, conn, entries):
+        """Batched spill-metadata registration: [(oid_hex, node_id, path,
+        nbytes, crc)]. Recorded alongside the holder entry so the
+        node-death path can hand the file to a surviving raylet on the
+        host (the spill dir lives outside the dead process)."""
+        for oid_hex, node_id, path, nbytes, crc in entries:
+            slot = self.object_locations.setdefault(oid_hex, {}).setdefault(
+                node_id, {"nbytes": int(nbytes), "spill": None}
+            )
+            slot["nbytes"] = int(nbytes)
+            slot["spill"] = {"path": path, "nbytes": int(nbytes), "crc": crc}
         return True
 
     def handle_object_location_remove(self, conn, entries):
@@ -771,7 +882,7 @@ class GcsServer:
         if not holders:
             return []
         out = []
-        for node_id, nbytes in holders.items():
+        for node_id, info in holders.items():
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
                 continue
@@ -780,7 +891,8 @@ class GcsServer:
                 "address": node.address,
                 "session": node.session,
                 "transfer_port": getattr(node, "transfer_port", None),
-                "nbytes": nbytes,
+                "nbytes": info["nbytes"],
+                "spilled": bool(info.get("spill")),
             })
         if len(out) > 1:
             rot = self._object_loc_rr.get(oid_hex, 0) % len(out)

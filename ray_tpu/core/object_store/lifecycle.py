@@ -1,0 +1,130 @@
+"""Per-object lifecycle state machine for the node object store.
+
+Every object a raylet knows about is in exactly one of five states:
+
+- ``PRIMARY``    — this node holds the authoritative in-memory copy (the
+  owner `put` it here, or this node was promoted after the previous
+  primary's node died). May additionally have a spill file backing it.
+- ``SECONDARY``  — an in-memory cache copy created by a pull; the
+  authoritative copy lives elsewhere. Cheap to drop under pressure.
+- ``SPILLED``    — no in-memory copy; the bytes live only in this node's
+  spill file. Restorable on demand.
+- ``RESTORING``  — a spill file is being read back into shm right now;
+  concurrent readers wait on the in-flight restore instead of issuing a
+  second disk read.
+- ``FREED``      — terminal. The owner released its last reference (or the
+  object was force-deleted); both the shm file and the spill file are gone.
+
+The transition table is explicit and closed: every state change in the
+store goes through :meth:`ObjectRecord.transition`, and an edge not listed
+in ``LEGAL_TRANSITIONS`` raises :class:`IllegalTransitionError` instead of
+silently corrupting the ledger. This is the contract the rest of the object
+plane builds on — pinning, proactive spill, dead-node promotion and
+restore-on-get are all expressed as walks over this graph.
+
+Parity: plasma's ObjectLifecycleManager tracks created/sealed/evicted
+implicitly through refcounts; here the states are reified so the raylet,
+the GCS directory, and the chaos harness can all assert on them.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import FrozenSet, Optional, Tuple
+
+
+class ObjectState(enum.Enum):
+    PRIMARY = "primary"
+    SECONDARY = "secondary"
+    SPILLED = "spilled"
+    RESTORING = "restoring"
+    FREED = "freed"
+
+
+class IllegalTransitionError(RuntimeError):
+    """An object-state edge outside ``LEGAL_TRANSITIONS`` was requested."""
+
+    def __init__(self, oid_hex: str, src: ObjectState, dst: ObjectState):
+        super().__init__(
+            f"object {oid_hex}: illegal lifecycle transition "
+            f"{src.value} -> {dst.value}"
+        )
+        self.oid_hex = oid_hex
+        self.src = src
+        self.dst = dst
+
+
+#: The closed set of legal edges. Everything else raises.
+#:
+#: PRIMARY   -> SPILLED    proactive spill / spill-backed eviction drops shm copy
+#: PRIMARY   -> FREED      owner freed the last reference
+#: SECONDARY -> PRIMARY    promotion after the primary holder's node died
+#: SECONDARY -> FREED      dropped under pressure or owner free
+#: SPILLED   -> RESTORING  a get() needs the bytes back in shm
+#: SPILLED   -> FREED      owner freed while only the disk copy existed
+#: RESTORING -> PRIMARY    restore completed (bytes back in shm)
+#: RESTORING -> SPILLED    restore failed (no capacity / chaos); disk copy stands
+#: RESTORING -> FREED      owner freed mid-restore
+LEGAL_TRANSITIONS: FrozenSet[Tuple[ObjectState, ObjectState]] = frozenset({
+    (ObjectState.PRIMARY, ObjectState.SPILLED),
+    (ObjectState.PRIMARY, ObjectState.FREED),
+    (ObjectState.SECONDARY, ObjectState.PRIMARY),
+    (ObjectState.SECONDARY, ObjectState.FREED),
+    (ObjectState.SPILLED, ObjectState.RESTORING),
+    (ObjectState.SPILLED, ObjectState.FREED),
+    (ObjectState.RESTORING, ObjectState.PRIMARY),
+    (ObjectState.RESTORING, ObjectState.SPILLED),
+    (ObjectState.RESTORING, ObjectState.FREED),
+})
+
+
+def spill_crc(data) -> int:
+    """Checksum recorded with spill metadata and re-verified on restore /
+    dead-node adoption, so a truncated or torn spill file fails typed
+    instead of returning wrong bytes."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+@dataclass
+class ObjectRecord:
+    """Ledger entry for one object on one node.
+
+    ``pin_expires`` is a monotonic-clock lease deadline: the owner renews it
+    while live references exist (piggybacked on the owner-metadata batch
+    flush), so a crashed owner's pins age out instead of wedging eviction.
+    A pinned PRIMARY may be spilled to disk (the bytes survive) but its
+    record is never FREED by pressure — only by the owner or lease expiry.
+    """
+
+    nbytes: int
+    created_at: float
+    last_access: float
+    state: ObjectState = ObjectState.PRIMARY
+    pin_expires: float = 0.0  # monotonic deadline; 0 = not pinned
+    spill_path: Optional[str] = None
+    spill_crc: Optional[int] = None
+
+    def pinned(self, now: Optional[float] = None) -> bool:
+        if self.pin_expires <= 0:
+            return False
+        return (now if now is not None else time.monotonic()) < self.pin_expires
+
+    def pin(self, ttl_s: float, now: Optional[float] = None) -> None:
+        """Set / renew the owner's pin lease (monotonically extends)."""
+        now = now if now is not None else time.monotonic()
+        self.pin_expires = max(self.pin_expires, now + ttl_s)
+
+    def unpin(self) -> None:
+        self.pin_expires = 0.0
+
+    @property
+    def in_memory(self) -> bool:
+        return self.state in (ObjectState.PRIMARY, ObjectState.SECONDARY)
+
+    def transition(self, dst: ObjectState, oid_hex: str = "?") -> None:
+        if (self.state, dst) not in LEGAL_TRANSITIONS:
+            raise IllegalTransitionError(oid_hex, self.state, dst)
+        self.state = dst

@@ -8,7 +8,10 @@ owns every inbound object transfer. Responsibilities here:
 - **admission**: total in-flight transfer bytes are bounded by
   ``pull_max_inflight_bytes``; excess pulls park in a priority queue where
   task-arg pulls (``priority="arg"``) are admitted ahead of background
-  prefetches/restores (``priority="prefetch"``);
+  prefetches/restores (``priority="prefetch"``), and the byte budget is
+  split fairly across jobs with live queued pulls — a job already at or
+  over its ``bound / active_jobs`` share parks behind under-share jobs of
+  the same class instead of monopolising the budget FIFO-style;
 - **transport ladder**: chunked stream-plane transfer (chunk_transfer.py,
   resumable + striped) → native sendfile daemon → monolithic rpc fetch;
 - **capacity**: every ingest path reserves store capacity via
@@ -60,7 +63,12 @@ class PullManager:
         self._get_gcs = get_gcs      # () -> GCS rpc connection (or None)
         self._inflight: Dict[bytes, asyncio.Future] = {}
         self._inflight_bytes = 0
-        self._waitq: List[tuple] = []  # heap: (priority, seq, future)
+        # per-job in-flight bytes: the admission budget is split across
+        # jobs with live pulls, so one job's deep prefetch queue can't
+        # starve another job's first arg pull behind a global FIFO
+        self._job_inflight: Dict[str, int] = {}
+        # heap: (priority_class, over_share, seq, gate, job)
+        self._waitq: List[tuple] = []
         # effective admission class per in-flight oid (dedup callers with
         # a better class upgrade a parked pull's next re-park)
         self._pending_prio: Dict[bytes, int] = {}
@@ -123,7 +131,8 @@ class PullManager:
     # ------------------------------------------------------------ public
     async def pull(self, oid: ObjectID, source_addr: Optional[str],
                    nbytes: Optional[int] = None, priority: str = "arg",
-                   transport: Optional[str] = None) -> dict:
+                   transport: Optional[str] = None,
+                   job_id: Optional[str] = None) -> dict:
         """Pull ``oid`` into the local store. Returns ``{"ok": True}`` or
         ``{"ok": False, "reason": ...}`` (typed capacity refusal included).
         Concurrent callers for one oid share the first caller's transfer."""
@@ -149,7 +158,7 @@ class PullManager:
         self._pending_prio[key] = _PRIORITIES.get(priority, 1)
         try:
             result = await self._admitted(oid, source_addr, nbytes,
-                                          priority, transport)
+                                          priority, transport, job_id)
         except Exception as e:  # noqa: BLE001 - a pull must fail typed
             logger.exception("pull %s failed", oid.hex()[:16])
             result = {"ok": False, "reason": repr(e)}
@@ -179,10 +188,29 @@ class PullManager:
         return gone
 
     # ---------------------------------------------------------- admission
-    async def _admitted(self, oid, source_addr, nbytes, priority, transport):
+    def _fair_share(self, job: str) -> float:
+        """This job's slice of the byte budget: ``bound / active_jobs``,
+        where active = jobs with in-flight bytes or parked pulls."""
+        bound = max(1, _config.pull_max_inflight_bytes)
+        active = {j for j, b in self._job_inflight.items() if b > 0}
+        for entry in self._waitq:
+            if not entry[3].done():
+                active.add(entry[4])
+        active.add(job)
+        return bound / len(active)
+
+    def _over_share(self, job: str, need: int) -> int:
+        """1 when admitting ``need`` more bytes would put this job over
+        its fair share (and other jobs are in play), else 0."""
+        share = self._fair_share(job)
+        return int(self._job_inflight.get(job, 0) + need > share)
+
+    async def _admitted(self, oid, source_addr, nbytes, priority, transport,
+                        job_id=None):
         bound = max(1, _config.pull_max_inflight_bytes)
         need = int(nbytes or 0)
         key = oid.binary()
+        job = job_id or "_"
         # ONE size-scaled deadline covers parking AND the transfer ladder:
         # the raylet must give up before the owner's rpc call (deadline +
         # 30s) does, or an abandoned pull keeps queueing/streaming while
@@ -192,7 +220,8 @@ class PullManager:
                 self._inflight_bytes + need > bound
                 or self._blocked_ahead(
                     self._pending_prio.get(key,
-                                           _PRIORITIES.get(priority, 1)))):
+                                           _PRIORITIES.get(priority, 1)),
+                    self._over_share(job, need))):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return {"ok": False, "reason": "pull admission timed out"}
@@ -200,9 +229,10 @@ class PullManager:
             heapq.heappush(
                 self._waitq,
                 # a dedup caller may have upgraded this pull's class while
-                # it was parked — re-read it on every re-park
+                # it was parked, and the job's share drifts as pulls of
+                # other jobs come and go — re-read both on every re-park
                 (self._pending_prio.get(key, _PRIORITIES.get(priority, 1)),
-                 next(self._seq), gate),
+                 self._over_share(job, need), next(self._seq), gate, job),
             )
             self.stats["queued"] += 1
             self._observe()
@@ -211,31 +241,41 @@ class PullManager:
             except asyncio.TimeoutError:
                 return {"ok": False, "reason": "pull admission timed out"}
         self._inflight_bytes += need
+        self._job_inflight[job] = self._job_inflight.get(job, 0) + need
         self._observe()
         try:
             return await self._transfer(oid, source_addr, nbytes, transport,
                                         deadline)
         finally:
             self._inflight_bytes -= need
+            left = self._job_inflight.get(job, 0) - need
+            if left > 0:
+                self._job_inflight[job] = left
+            else:
+                self._job_inflight.pop(job, None)
             self._wake_parked()
             self._observe()
 
-    def _blocked_ahead(self, cls: int) -> bool:
+    def _blocked_ahead(self, cls: int, over: int = 0) -> bool:
         """Queue barrier: a new pull may not slip past a PARKED pull of an
-        equal-or-better class — without this, steady small-pull traffic
-        keeps the budget partially full forever and any pull larger than
-        the free headroom starves to its deadline."""
-        while self._waitq and self._waitq[0][2].done():
+        equal-or-better (class, fairness) rank — without this, steady
+        small-pull traffic keeps the budget partially full forever and any
+        pull larger than the free headroom starves to its deadline. The
+        fairness bit makes the barrier per-job: an under-share job's first
+        pull is NOT blocked by another job's parked over-share backlog."""
+        while self._waitq and self._waitq[0][3].done():
             heapq.heappop(self._waitq)  # prune timed-out/cancelled gates
-        return bool(self._waitq) and self._waitq[0][0] <= cls
+        return bool(self._waitq) and \
+            (self._waitq[0][0], self._waitq[0][1]) <= (cls, over)
 
     def _wake_parked(self) -> None:
-        """Wake EVERY parked pull in priority order: each re-checks the
-        budget and re-parks if it still doesn't fit. Waking only one
-        collapsed concurrency to one-pull-per-completion once a queue
-        formed, even with most of the byte budget free."""
+        """Wake EVERY parked pull in (class, fairness) order: each
+        re-checks the budget and re-parks if it still doesn't fit. Waking
+        only one collapsed concurrency to one-pull-per-completion once a
+        queue formed, even with most of the byte budget free."""
         while self._waitq:
-            _prio, _seq, gate = heapq.heappop(self._waitq)
+            entry = heapq.heappop(self._waitq)
+            gate = entry[3]
             if not gate.done():
                 gate.set_result(None)
 
@@ -272,7 +312,10 @@ class PullManager:
     async def _finish(self, oid, n: int, kind: str) -> dict:
         self.stats[kind] += 1
         self._count_bytes(n)
-        self.directory.add(oid, n)
+        # a pulled copy is a SECONDARY in the lifecycle machine: cheap to
+        # drop under pressure (the authoritative copy lives elsewhere),
+        # promotable to PRIMARY if the original holder's node dies
+        self.directory.add(oid, n, role="secondary")
         # register only copies big enough that _sources will ever look
         # them up — sub-chunk objects would grow the GCS table and pay a
         # notify per pull for a directory nobody queries

@@ -128,10 +128,13 @@ class Raylet:
             get_gcs=lambda: self.gcs,
         )
         # eviction/free of a secondary copy deregisters it from the GCS
-        # location table (listener fires on arbitrary threads, so the
-        # notify is trampolined onto the raylet loop)
+        # location table; a spill-file write registers its metadata there
+        # so a surviving node can adopt it after this raylet dies (both
+        # listeners fire on arbitrary threads, so the notifies are
+        # trampolined onto the raylet loop)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.directory.evict_listener = self._on_objects_evicted
+        self.directory.spill_listener = self._on_objects_spilled
         self._pushes_served = 0            # chunk ranges served to pullers
         # outbound chunk pushes run on their own bounded pool, isolated
         # from the pull manager's receiver waits — a local pull burst must
@@ -242,6 +245,7 @@ class Raylet:
         self._bg.append(asyncio.create_task(self._task_events_flush_loop()))
         self._bg.append(asyncio.create_task(self._orphan_wal_scan_loop()))
         self._bg.append(asyncio.create_task(self._wal_ship_loop()))
+        self._bg.append(asyncio.create_task(self._spill_loop()))
         if _config.enable_worker_prestart:
             n = min(2, int(self.total.get("CPU")) or 1)
             for _ in range(n):
@@ -355,6 +359,25 @@ class Raylet:
         g_spill = metrics_api.Gauge(
             "object_store_num_spilled", "objects spilled to disk"
         )
+        g_pinned = metrics_api.Gauge(
+            "object_pinned_bytes",
+            "bytes of objects under a live owner pin lease",
+        )
+        g_spilled_b = metrics_api.Gauge(
+            "object_spilled_bytes", "bytes of objects backed by spill files"
+        )
+        g_state = metrics_api.Gauge(
+            "object_lifecycle_state",
+            "local objects by lifecycle state", tag_keys=("state",),
+        )
+        c_spilled = metrics_api.Counter(
+            "object_spilled_total", "spill files written by this raylet"
+        )
+        c_restored = metrics_api.Counter(
+            "object_restored_total",
+            "spilled objects restored into shm by this raylet",
+        )
+        last_spills = last_restores = 0
         g_ticks = metrics_api.Gauge(
             "raylet_dispatch_ticks", "poll-loop iterations completed"
         )
@@ -375,6 +398,15 @@ class Raylet:
                 g_bytes.set(st.get("used_bytes", 0))
                 g_objs.set(st.get("num_objects", 0))
                 g_spill.set(st.get("num_spilled", 0))
+                g_pinned.set(st.get("pinned_bytes", 0))
+                g_spilled_b.set(st.get("spilled_bytes", 0))
+                for state, n in (st.get("states") or {}).items():
+                    g_state.set(n, tags={"state": state})
+                c_spilled.inc(float(st.get("num_spills", 0) - last_spills))
+                last_spills = st.get("num_spills", 0)
+                c_restored.inc(
+                    float(st.get("num_restores", 0) - last_restores))
+                last_restores = st.get("num_restores", 0)
                 g_ticks.set(getattr(self, "_poll_ticks", -1))
                 for k, v in getattr(self, "_disp", {}).items():
                     metrics_api.Gauge(
@@ -402,6 +434,27 @@ class Raylet:
             tracing.get_buffer(), lambda: self.gcs,
             source=f"raylet-{self.node_id}", use_notify=True,
         )
+
+    async def _spill_loop(self):
+        """Proactive spill: once in-memory use crosses
+        ``object_spill_threshold_frac`` of capacity, move cold PRIMARY
+        copies to the spill dir (LRU by last access) until back under the
+        threshold. Pressure-time eviction then degrades to a cheap unlink
+        of already-disk-backed copies, and a SIGKILLed raylet leaves spill
+        files + GCS-registered metadata behind for a survivor to adopt.
+        The disk writes run on an executor thread, never the raylet loop."""
+        period = max(0.05, _config.object_spill_interval_s)
+        frac = min(1.0, max(0.0, _config.object_spill_threshold_frac))
+        while True:
+            try:
+                target = int(self.directory.capacity * frac)
+                if self.directory.used > target:
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, self.directory.spill_cold, target
+                    )
+            except Exception:  # noqa: BLE001 - spill must never kill raylet
+                logger.exception("proactive spill sweep failed")
+            await asyncio.sleep(period)
 
     # ----------------------------------------------------------- scheduling
     def handle_worker_blocked(self, conn, worker_id: str):
@@ -1315,15 +1368,61 @@ class Raylet:
 
     # ------------------------------------------------------------- objects
     def handle_object_added(self, conn, oid_hex, nbytes):
-        self.directory.add(ObjectID.from_hex(oid_hex), nbytes)
+        """An owner sealed a shm object here: it enters the lifecycle
+        machine as a pinned PRIMARY (the notifier IS the owner, so the add
+        doubles as the first pin lease; renewals arrive on the owner's
+        metadata batch plane)."""
+        oid = ObjectID.from_hex(oid_hex)
+        self.directory.add(oid, nbytes, role="primary")
+        self.directory.pin(oid, _config.object_pin_ttl_s)
         return True
 
     def handle_object_added_batch(self, conn, entries):
         """Batched location records: owners flush (oid, nbytes) pairs in
         groups off the put/return hot path."""
         for oid_hex, nbytes in entries:
-            self.directory.add(ObjectID.from_hex(oid_hex), nbytes)
+            oid = ObjectID.from_hex(oid_hex)
+            self.directory.add(oid, nbytes, role="primary")
+            self.directory.pin(oid, _config.object_pin_ttl_s)
         return True
+
+    def handle_pin_objects(self, conn, entries):
+        """Owner pin-lease renewal (batched on the owner-metadata plane):
+        extend each primary's lease by the configured TTL. Unknown oids
+        are ignored — the owner may be renewing something already freed."""
+        n = 0
+        for oid_hex in entries:
+            if self.directory.pin(ObjectID.from_hex(oid_hex),
+                                  _config.object_pin_ttl_s):
+                n += 1
+        return n
+
+    def handle_promote_primary(self, conn, oids_hex):
+        """GCS death path: this node's SECONDARY copies of a dead node's
+        primaries become the authoritative PRIMARY copies (lifecycle
+        SECONDARY -> PRIMARY edge). Returns the subset actually held."""
+        promoted = []
+        for oid_hex in oids_hex:
+            if self.directory.promote(ObjectID.from_hex(oid_hex)):
+                promoted.append(oid_hex)
+        return promoted
+
+    async def handle_adopt_spill(self, conn, entries):
+        """GCS death path, no in-memory survivor: adopt a dead same-host
+        raylet's spill files (path, nbytes, crc all GCS-registered at
+        spill time). The crc re-verify + file read run on an executor
+        thread. Returns the oids adopted; the GCS re-registers them under
+        this node so pulls and restores route here."""
+        adopted = []
+        loop = asyncio.get_running_loop()
+        for oid_hex, path, nbytes, crc in entries:
+            ok = await loop.run_in_executor(
+                None, self.directory.adopt_spill,
+                ObjectID.from_hex(oid_hex), path, nbytes, crc,
+            )
+            if ok:
+                adopted.append(oid_hex)
+        return adopted
 
     def handle_object_stats(self, conn):
         return self.directory.stats()
@@ -1331,8 +1430,10 @@ class Raylet:
     def handle_free_objects(self, conn, oids_hex):
         oids = [ObjectID.from_hex(h) for h in oids_hex]
         for oid in oids:
+            # delete() fires the eviction listener for every record it
+            # drops (spill-backed included), which deregisters the GCS
+            # locations via _drop_secondaries — no direct call needed
             self.directory.delete(oid)
-        self._drop_secondaries(oids)
         return True
 
     async def handle_fetch_object(self, conn, oid_hex):
@@ -1358,7 +1459,7 @@ class Raylet:
 
     async def handle_pull_object(self, conn, oid_hex, source_addr,
                                  nbytes=None, priority="arg",
-                                 transport=None):
+                                 transport=None, job_id=None):
         """Pull an object from a remote raylet into the local store.
 
         Parity: PullManager/PushManager — all inbound transfers funnel
@@ -1368,7 +1469,7 @@ class Raylet:
         ``{"ok": True}`` / ``{"ok": False, "reason": ...}``."""
         return await self.pulls.pull(
             ObjectID.from_hex(oid_hex), source_addr, nbytes=nbytes,
-            priority=priority, transport=transport,
+            priority=priority, transport=transport, job_id=job_id,
         )
 
     async def handle_push_chunks(self, conn, oid_hex, indices, nbytes,
@@ -1413,15 +1514,43 @@ class Raylet:
         no puller is ever routed to a holder that just dropped its copy."""
         self._drop_secondaries(oids)
 
+    def _on_objects_spilled(self, entries) -> None:
+        """Directory spill listener (arbitrary thread, lock released):
+        register each new spill file's metadata (path, nbytes, crc) in the
+        GCS secondary-copy directory, so the death path can hand the file
+        to a surviving raylet on the same host."""
+        if self._loop is None:
+            return
+        payload = [(oid.hex(), self.node_id, path, nbytes, crc)
+                   for oid, path, nbytes, crc in entries]
+        self._loop.call_soon_threadsafe(
+            lambda: self._hold(asyncio.ensure_future(
+                self._register_spills(payload)
+            ))
+        )
+
+    async def _register_spills(self, entries) -> None:
+        if self.gcs is None or self.gcs.closed:
+            return
+        try:
+            await self.gcs.notify("object_location_spill", entries=entries)
+        except (rpc.RpcError, rpc.ConnectionLost):
+            pass  # soft state: the copy just isn't adoptable after a death
+
     def _drop_secondaries(self, oids) -> None:
         """Single teardown path for vanished local copies (free, evict):
         forget them in the pull manager and deregister them at the GCS.
-        Callable from ANY thread — the notify is trampolined onto the
-        raylet loop (call_soon_threadsafe is loop-thread-safe too)."""
-        gone = self.pulls.on_local_drop(oids)
-        if not gone or self._loop is None:
+        EVERY vanished oid is deregistered, not just advertised
+        secondaries — a freed spill-backed primary was registered via
+        object_location_spill, and leaving that entry behind would route
+        pullers (and the death path's adoption) at a spill file that no
+        longer exists. Unknown entries are a no-op at the GCS. Callable
+        from ANY thread — the notify is trampolined onto the raylet loop
+        (call_soon_threadsafe is loop-thread-safe too)."""
+        self.pulls.on_local_drop(oids)
+        if not oids or self._loop is None:
             return
-        entries = [(oid.hex(), self.node_id) for oid in gone]
+        entries = [(oid.hex(), self.node_id) for oid in oids]
         self._loop.call_soon_threadsafe(
             lambda: self._hold(asyncio.ensure_future(
                 self._deregister_locations(entries)

@@ -61,6 +61,8 @@ EXPECTED_ROWS: List[str] = [
     "object pull chunked/rpc ratio",
     "object pull striped 2-source (MB/s)",
     "object broadcast 4 pullers (origin serves)",
+    "object spill to disk (MB/s)",
+    "object restore from spill (MB/s)",
 ]
 
 
@@ -269,6 +271,9 @@ def main(duration: float = 2.0, json_path: str = "", smoke: bool = False):
 
     # ----------------------------------------------------- object plane
     _object_plane_benchmarks(ray_tpu, results, smoke)
+
+    # ------------------------------------------------- spill / restore
+    _lifecycle_benchmarks(results, smoke)
 
     payload = {"microbenchmark": results}
     print(json.dumps(payload))
@@ -501,6 +506,64 @@ def _object_plane_benchmarks(ray_tpu, results, smoke: bool = False):
             os.environ.pop("RAY_TPU_PULL_STRIPE_MIN_BYTES", None)
         else:
             os.environ["RAY_TPU_PULL_STRIPE_MIN_BYTES"] = saved_env
+
+
+def _lifecycle_benchmarks(results, smoke: bool = False):
+    """Object lifecycle spill/restore throughput: a directly-driven
+    ObjectDirectory (no cluster) spilling cold primaries to disk and
+    restoring them back into shm through the crc-checked RESTORING path.
+    The floor the proactive spill loop and restore-on-get can sustain."""
+    import os
+    import shutil
+    import tempfile
+    import uuid
+
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store.shm_store import (
+        ObjectDirectory,
+        ShmClient,
+        session_dir,
+    )
+
+    size = (1 if smoke else 8) * 1024 * 1024
+    count = 2 if smoke else 8
+    session = f"bench{uuid.uuid4().hex[:10]}"
+    client = ShmClient(session)
+    spill_dir = os.path.join(tempfile.gettempdir(), f"spill_{session}")
+    directory = ObjectDirectory(
+        client, capacity_bytes=2 * count * size, spill_dir=spill_dir
+    )
+    try:
+        blob = np.random.default_rng(1).integers(
+            0, 255, size=size, dtype=np.uint8
+        ).tobytes()
+        oids = [ObjectID.from_random() for _ in range(count)]
+        for oid in oids:
+            client.put_bytes(oid, blob)
+            directory.add(oid, size, role="primary")
+
+        t0 = time.perf_counter()
+        spilled = directory.spill_cold(0)  # everything is cold: spill all
+        dt = time.perf_counter() - t0
+        assert spilled == count, (spilled, count)
+        rate = count * size / dt / 1e6
+        name = "object spill to disk (MB/s)"
+        print(f"{name:<50s} {rate:>10.1f} MB/s")
+        results.append({"name": name, "mb_per_s": round(rate, 1)})
+
+        t0 = time.perf_counter()
+        for oid in oids:
+            assert directory.restore(oid)
+        dt = time.perf_counter() - t0
+        rate = count * size / dt / 1e6
+        name = "object restore from spill (MB/s)"
+        print(f"{name:<50s} {rate:>10.1f} MB/s")
+        results.append({"name": name, "mb_per_s": round(rate, 1)})
+    finally:
+        directory.destroy()
+        client.destroy()
+        shutil.rmtree(spill_dir, ignore_errors=True)
+        shutil.rmtree(session_dir(session), ignore_errors=True)
 
 
 def _chunk_source(n):

@@ -118,6 +118,63 @@ def cmd_list(args) -> int:
     return 0
 
 
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def cmd_objects(args) -> int:
+    """Per-node object-store lifecycle view: one row per raylet with the
+    live lifecycle-state census (primary/secondary/spilled/restoring),
+    pinned and spill-backed bytes, and cumulative spill/restore/eviction
+    counters — the operator's window into the object lifecycle plane."""
+    _connect(args)
+    from ray_tpu.api import _global_worker
+
+    core = _global_worker().backend.core
+
+    async def _collect():
+        view = await core.gcs.call("get_resource_view", timeout=30)
+        rows = {}
+        for nid, info in sorted(view.items()):
+            addr = info.get("address")
+            if not addr:
+                continue
+            try:
+                conn = await core._conn_to(addr, kind="raylet")
+                rows[nid] = await conn.call("object_store_stats", timeout=10)
+            except Exception as e:  # noqa: BLE001 - per-node row, not fatal
+                rows[nid] = {"error": str(e)}
+        return rows
+
+    rows = core.io.run(_collect(), timeout=60)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    print(f"{'node':<16s} {'objs':>5s} {'used':>10s} {'capacity':>10s} "
+          f"{'pinned':>10s} {'spilled':>10s} {'spills':>7s} "
+          f"{'restores':>8s} {'evicted':>7s}  states")
+    for nid, st in rows.items():
+        if "error" in st:
+            print(f"{nid:<16s} error: {st['error']}")
+            continue
+        states = ",".join(
+            f"{k}={v}" for k, v in sorted(st.get("states", {}).items()) if v
+        )
+        print(f"{nid:<16s} {st['num_objects']:>5d} "
+              f"{_fmt_bytes(st['used_bytes']):>10s} "
+              f"{_fmt_bytes(st['capacity_bytes']):>10s} "
+              f"{_fmt_bytes(st.get('pinned_bytes', 0)):>10s} "
+              f"{_fmt_bytes(st.get('spilled_bytes', 0)):>10s} "
+              f"{st.get('num_spills', 0):>7d} "
+              f"{st.get('num_restores', 0):>8d} "
+              f"{st.get('num_evicted', 0):>7d}  {states or '-'}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     import time as _time
 
@@ -499,6 +556,14 @@ def main(argv=None) -> int:
                                       "placement-groups"])
     p.add_argument("--address")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser(
+        "objects", help="per-node object-store lifecycle view "
+        "(state census, pinned/spilled bytes, spill/restore counters)")
+    p.add_argument("--address")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.set_defaults(fn=cmd_objects)
 
     p = sub.add_parser("microbenchmark", help="core op/s microbenchmarks")
     p.set_defaults(fn=cmd_microbenchmark)

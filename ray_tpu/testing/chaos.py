@@ -164,6 +164,22 @@ REGISTERED_POINTS: Dict[str, Dict[str, Any]] = {
                  "arbitrary-offset crash with the mutation durable but its "
                  "reply unsent (no pre-exit snapshot flush exists)",
     },
+    "object.spill": {
+        "module": "ray_tpu/core/object_store/shm_store.py",
+        "builders": ["fail_spill"],
+        "where": "object-store spill-file write: the Nth matching spill "
+                 "fails (simulated disk failure), so eviction must refuse "
+                 "with a typed store-full error rather than silently drop "
+                 "a pinned primary",
+    },
+    "object.restore": {
+        "module": "ray_tpu/core/object_store/shm_store.py",
+        "builders": ["fail_restore"],
+        "where": "restore-on-get read of a spilled object: the Nth "
+                 "matching restore fails (torn/lost spill file), so the "
+                 "caller must fall down the transfer ladder to another "
+                 "holder or fail typed — never return corrupt bytes",
+    },
 }
 
 
@@ -279,6 +295,27 @@ class ChaosPlan:
         handles (after the handler mutated state, before the reply — the
         caller sees a lost connection). The test harness restarts it."""
         return self._rule("rpc.handle", "exit", match=on_call, nth=nth)
+
+    def fail_spill(self, match: str = "", nth: int = 1,
+                   repeat: bool = False, times: int = 0) -> "ChaosPlan":
+        """Fail the Nth spill-file write whose object id contains ``match``
+        (empty = any spill) — a simulated disk failure. A pinned primary
+        whose spill fails must surface a typed store-full refusal upstream,
+        never be silently dropped. ``repeat=True`` fails every Nth matching
+        spill, bounded by ``times`` total firings (0 = unbounded)."""
+        return self._rule("object.spill", "fail", match=match, nth=nth,
+                          repeat=repeat, times=times)
+
+    def fail_restore(self, match: str = "", nth: int = 1,
+                     repeat: bool = False, times: int = 0) -> "ChaosPlan":
+        """Fail the Nth restore-on-get read of a spilled object whose id
+        contains ``match`` (empty = any restore) — a torn or lost spill
+        file. The getter must fall through to another holder over the
+        transfer ladder or fail typed; corrupt bytes must never be
+        returned. ``repeat=True`` fails every Nth matching restore,
+        bounded by ``times`` total firings (0 = unbounded)."""
+        return self._rule("object.restore", "fail", match=match, nth=nth,
+                          repeat=repeat, times=times)
 
     def kill_gcs_at_wal(self, nth: int = 1, match: str = "") -> "ChaosPlan":
         """Hard-exit the GCS right after the Nth write-ahead-log record

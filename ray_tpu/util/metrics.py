@@ -161,6 +161,13 @@ KNOWN_METRICS: Dict[str, str] = {
     "object_store_used_bytes": "bytes sealed in the local shm store",
     "object_store_num_objects": "objects in the local shm store",
     "object_store_num_spilled": "objects spilled to disk",
+    # object lifecycle governance (object_store/lifecycle.py)
+    "object_pinned_bytes": "bytes of owner-pinned primary copies",
+    "object_spilled_bytes": "bytes of spill-backed objects on disk",
+    "object_lifecycle_state": "objects by lifecycle state",
+    "object_spilled_total": "objects spilled to disk (proactive + "
+                            "eviction-driven)",
+    "object_restored_total": "spilled objects restored into shm on get",
     # object plane: pull-based transfer + locality scheduling
     "object_transfer_bytes_total": "object bytes pulled into this node's "
                                    "store",

@@ -1,0 +1,653 @@
+"""Object lifecycle governance (ISSUE 17): one state machine for primary
+pinning, proactive spill, dead-node restore, and last-resort lineage
+recovery.
+
+Unit level drives ObjectDirectory/ObjectRecord directly; cluster level uses
+SPLIT shm sessions (same pattern as test_object_plane.py) so transfers,
+spills and node deaths are genuine — a killed raylet's shm really is
+unreachable, only its spill files survive on the shared host disk.
+"""
+
+import asyncio
+import os
+import shutil
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import _config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store.lifecycle import (
+    LEGAL_TRANSITIONS,
+    IllegalTransitionError,
+    ObjectRecord,
+    ObjectState,
+    spill_crc,
+)
+from ray_tpu.core.object_store.shm_store import ObjectDirectory, ShmClient
+
+_CHUNK = 256 * 1024
+_ENV = {
+    "RAY_TPU_PULL_CHUNK_BYTES": str(_CHUNK),
+}
+# aggressive-spill daemon env: spill EVERY cold primary on a fast sweep
+_SPILL_ENV = {
+    **_ENV,
+    "RAY_TPU_OBJECT_SPILL_THRESHOLD_FRAC": "0.0",
+    "RAY_TPU_OBJECT_SPILL_INTERVAL_S": "0.1",
+}
+
+
+def _start_split_cluster(specs, extra_env=None):
+    """GCS + one raylet per spec, each raylet in its OWN shm session."""
+    from ray_tpu.core.cluster_backend import (
+        ProcessGroup,
+        _session_tmp_dir,
+        start_gcs,
+        start_raylet,
+    )
+
+    ray_tpu.shutdown()
+    env = dict(_ENV)
+    env.update(extra_env or {})
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    sessions = []
+    procs = ProcessGroup(_session_tmp_dir(f"s{uuid.uuid4().hex[:10]}"))
+    gcs = start_gcs(procs)
+    for spec in specs:
+        session = f"s{uuid.uuid4().hex[:10]}"
+        sessions.append(session)
+        start_raylet(
+            procs, gcs, session, spec["name"],
+            num_cpus=spec.get("num_cpus", 1), num_tpus=0,
+            resources=spec.get("resources"),
+            object_store_memory_mb=spec.get("store_mb"),
+        )
+    return procs, gcs, sessions, saved
+
+
+def _teardown_split_cluster(procs, sessions, saved):
+    from ray_tpu.core.object_store.shm_store import session_dir
+
+    ray_tpu.shutdown()
+    procs.shutdown()
+    for s in sessions:
+        shutil.rmtree(session_dir(s), ignore_errors=True)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _core():
+    from ray_tpu.api import _global_worker
+
+    return _global_worker().backend.core
+
+
+def _raylet_addr_of(core, node_id):
+    async def view():
+        return await core.gcs.call("get_resource_view", timeout=30)
+
+    nodes = core.io.run(view(), timeout=60)
+    return nodes[node_id]["address"]
+
+
+def _store_stats(core, addr=None):
+    async def stats():
+        if addr is None:
+            return await core.raylet.call("object_store_stats", timeout=30)
+        conn = await core._conn_to(addr, kind="raylet")
+        return await conn.call("object_store_stats", timeout=30)
+
+    return core.io.run(stats(), timeout=60)
+
+
+def _locations(core, oid_hex):
+    async def locs():
+        return await core.gcs.call(
+            "object_locations", oid_hex=oid_hex, timeout=30
+        )
+
+    return core.io.run(locs(), timeout=60)
+
+
+def _mkdir_directory(capacity_bytes=4 * 1024 * 1024):
+    session = f"t{uuid.uuid4().hex[:8]}"
+    client = ShmClient(session)
+    spill = os.path.join("/tmp", f"spill_{session}")
+    return client, ObjectDirectory(
+        client, capacity_bytes=capacity_bytes, spill_dir=spill
+    )
+
+
+# ------------------------------------------------------------- unit level
+def test_transition_matrix_is_exhaustive():
+    """Every one of the 25 (src, dst) state pairs either walks cleanly or
+    raises the typed IllegalTransitionError — exactly per the transition
+    table. No transition silently no-ops into a wrong state."""
+    for src in ObjectState:
+        for dst in ObjectState:
+            rec = ObjectRecord(nbytes=1, created_at=0.0, last_access=0.0,
+                               state=src)
+            if (src, dst) in LEGAL_TRANSITIONS:
+                rec.transition(dst, "aa")
+                assert rec.state is dst
+            else:
+                with pytest.raises(IllegalTransitionError) as ei:
+                    rec.transition(dst, "aa")
+                assert src.value in str(ei.value)
+                assert dst.value in str(ei.value)
+                assert rec.state is src  # state unchanged on refusal
+    # the table itself stays minimal: FREED is terminal, nothing enters
+    # RESTORING except from SPILLED
+    assert not any(src is ObjectState.FREED for src, _ in LEGAL_TRANSITIONS)
+    assert all(src is ObjectState.SPILLED
+               for src, dst in LEGAL_TRANSITIONS
+               if dst is ObjectState.RESTORING)
+
+
+def test_pin_lease_renews_and_expires():
+    rec = ObjectRecord(nbytes=8, created_at=0.0, last_access=0.0)
+    assert not rec.pinned()
+    rec.pin(ttl_s=30.0)
+    assert rec.pinned()
+    # renewal extends, never shortens
+    long_deadline = rec.pin_expires
+    rec.pin(ttl_s=0.001)
+    assert rec.pin_expires == long_deadline
+    # an expired lease ages out without any unpin call (owner crashed)
+    rec2 = ObjectRecord(nbytes=8, created_at=0.0, last_access=0.0)
+    rec2.pin(ttl_s=0.01)
+    time.sleep(0.05)
+    assert not rec2.pinned()
+    rec.unpin()
+    assert not rec.pinned()
+
+
+def test_pinned_primary_never_dropped_refusal_is_typed():
+    """Under pressure with spill failing (chaos object.spill), a pinned
+    primary must survive in memory and the capacity request must refuse
+    (False -> typed ObjectStoreFullError upstream) — never a silent
+    drop."""
+    from ray_tpu.testing import chaos
+
+    client, d = _mkdir_directory(capacity_bytes=1024 * 1024)
+    try:
+        oid = ObjectID.from_random()
+        data = os.urandom(700_000)
+        client.put_bytes(oid, data)
+        d.add(oid, len(data), role="primary")
+        assert d.pin(oid, ttl_s=60.0)
+        with chaos.plan(3).fail_spill(repeat=True):
+            refused = d.ensure_capacity(600_000)
+        assert refused is False
+        rec = d.entries[oid]
+        assert rec.state is ObjectState.PRIMARY and rec.in_memory
+        assert client.contains(oid)
+        # with spill working again the same request succeeds: the pinned
+        # primary moves to disk (never destroyed) and frees its shm bytes
+        assert d.ensure_capacity(600_000)
+        rec = d.entries[oid]
+        assert rec.state is ObjectState.SPILLED
+        assert rec.spill_path and os.path.exists(rec.spill_path)
+        assert d.restore(oid)  # and the live ref can still read it back
+        buf = client.get(oid)
+        try:
+            assert bytes(buf.buffer) == data
+        finally:
+            buf.close()
+    finally:
+        d.destroy()
+        client.destroy()
+
+
+def test_restore_refuses_torn_spill_file():
+    """A corrupted spill file fails the crc check: restore() returns False
+    (typed upstream, the pull ladder takes over) and NEVER returns wrong
+    bytes; the record drops back to SPILLED, not a half-restored state."""
+    client, d = _mkdir_directory()
+    try:
+        oid = ObjectID.from_random()
+        data = os.urandom(64 * 1024)
+        client.put_bytes(oid, data)
+        d.add(oid, len(data), role="primary")
+        assert d.spill_cold(0) == 1
+        rec = d.entries[oid]
+        with open(rec.spill_path, "r+b") as f:  # torn mid-write
+            f.seek(1000)
+            f.write(b"\x00" * 512)
+        assert spill_crc(open(rec.spill_path, "rb").read()) != rec.spill_crc
+        assert d.restore(oid) is False
+        assert d.entries[oid].state is ObjectState.SPILLED
+        assert not client.contains(oid)
+    finally:
+        d.destroy()
+        client.destroy()
+
+
+def test_chaos_fail_restore_is_typed_not_corrupt():
+    from ray_tpu.testing import chaos
+
+    client, d = _mkdir_directory()
+    try:
+        oid = ObjectID.from_random()
+        client.put_bytes(oid, os.urandom(32 * 1024))
+        d.add(oid, 32 * 1024, role="primary")
+        assert d.spill_cold(0) == 1
+        with chaos.plan(9).fail_restore() as plan:
+            assert d.restore(oid) is False
+            events = [e for e in plan.events()
+                      if e["point"] == "object.restore"]
+        assert events and events[0]["action"] == "fail"
+        assert d.entries[oid].state is ObjectState.SPILLED
+        assert d.restore(oid)  # next attempt (no injection) succeeds
+    finally:
+        d.destroy()
+        client.destroy()
+
+
+def test_delete_removes_spill_file_and_notifies():
+    """Owner free of a spill-backed object: record, shm copy and spill
+    file all go, and the eviction listener fires so the raylet
+    deregisters the (spill-registered) GCS location."""
+    client, d = _mkdir_directory()
+    notified = []
+    d.evict_listener = notified.extend
+    try:
+        oid = ObjectID.from_random()
+        client.put_bytes(oid, os.urandom(16 * 1024))
+        d.add(oid, 16 * 1024, role="primary")
+        assert d.spill_cold(0) == 1
+        spill_path = d.entries[oid].spill_path
+        assert os.path.exists(spill_path)
+        d.delete(oid)
+        assert oid not in d.entries
+        assert not os.path.exists(spill_path)
+        assert notified == [oid]
+    finally:
+        d.destroy()
+        client.destroy()
+
+
+# ------------------------------------------------------- pull fairness
+def test_pull_fairness_prevents_cross_job_starvation():
+    """Per-job budget fairness: job A floods the pull queue; job B's first
+    pull must admit as soon as a slot frees — ahead of A's parked
+    backlog — instead of waiting out A's whole FIFO queue."""
+    from ray_tpu.core.object_store.pull_manager import PullManager
+
+    saved = _config.pull_max_inflight_bytes
+    _config.pull_max_inflight_bytes = 2 * 1024 * 1024
+    session = f"t{uuid.uuid4().hex[:8]}"
+    client = ShmClient(session)
+    directory = ObjectDirectory(client, capacity_bytes=64 * 1024 * 1024)
+    mb = 1024 * 1024
+    admitted = []  # job label, in admission order
+    job_of = {}
+
+    async def scenario():
+        pm = PullManager(
+            node_id="n", session=session, shm=client, directory=directory,
+            get_view=lambda: {}, get_gcs=lambda: None,
+        )
+
+        async def fake_transfer(oid, source_addr, nbytes, transport,
+                                deadline):
+            admitted.append(job_of[oid.binary()])
+            await asyncio.sleep(0.1)
+            return {"ok": True}
+
+        pm._transfer = fake_transfer
+        a_pulls = []
+        for _ in range(6):
+            oid = ObjectID.from_random()
+            job_of[oid.binary()] = "A"
+            a_pulls.append(asyncio.create_task(
+                pm.pull(oid, None, nbytes=mb, job_id="jobA")
+            ))
+        await asyncio.sleep(0.03)  # 2 admit (2 MB budget), 4 park FIFO
+        oid_b = ObjectID.from_random()
+        job_of[oid_b.binary()] = "B"
+        b_pull = asyncio.create_task(
+            pm.pull(oid_b, None, nbytes=mb, job_id="jobB")
+        )
+        results = await asyncio.gather(*a_pulls, b_pull)
+        assert all(r["ok"] for r in results), results
+
+    try:
+        asyncio.run(scenario())
+        # B was submitted seventh but must admit right after the first
+        # slot frees: ahead of the 4 parked A pulls
+        assert admitted.index("B") <= 3, admitted
+        assert admitted.count("A") == 6 and admitted.count("B") == 1
+    finally:
+        _config.pull_max_inflight_bytes = saved
+        client.destroy()
+
+
+# ------------------------------------------------------- cluster level
+def test_proactive_spill_restore_on_get_and_metrics():
+    """Aggressive-spill raylet: produced objects move to disk in the
+    background; a later consumer restores them transparently (byte-
+    identical) and the spill/restore counters + metrics series record
+    both directions."""
+    procs, gcs, sessions, saved = _start_split_cluster(
+        [
+            {"name": "node-a", "num_cpus": 1},
+            {"name": "node-b", "num_cpus": 1, "resources": {"b": 1}},
+        ],
+        extra_env=_SPILL_ENV,
+    )
+    ray_tpu.init(address=gcs, _node_name="node-a")
+    try:
+        @ray_tpu.remote(resources={"b": 1})
+        def produce():
+            import numpy as _np
+
+            return _np.random.default_rng(21).integers(
+                0, 255, size=1024 * 1024, dtype=_np.uint8
+            )
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], timeout=60)
+        core = _core()
+        b_addr = _raylet_addr_of(core, "node-b")
+
+        deadline = time.monotonic() + 30
+        st = {}
+        while time.monotonic() < deadline:
+            st = _store_stats(core, b_addr)
+            if st["num_spills"] >= 1 and st["states"]["spilled"] >= 1:
+                break
+            time.sleep(0.2)
+        assert st.get("num_spills", 0) >= 1, st
+        assert st["used_bytes"] == 0, st  # shm copy unlinked after spill
+        # spill metadata registered at the GCS for the death path
+        locs = _locations(core, ref.id.hex())
+        assert any(h["node_id"] == "node-b" and h["spilled"]
+                   for h in locs), locs
+
+        @ray_tpu.remote(resources={"b": 1})
+        def consume(x):
+            return int(x.sum()) % 1_000_003
+
+        want = int(np.random.default_rng(21).integers(
+            0, 255, size=1024 * 1024, dtype=np.uint8
+        ).sum()) % 1_000_003
+        assert ray_tpu.get(consume.remote(ref), timeout=120) == want
+        st = _store_stats(core, b_addr)
+        assert st["num_restores"] >= 1, st
+
+        # the new metric series flow through the raylet flush into the
+        # GCS timeseries (KNOWN_METRICS names, RT006-checked)
+        from ray_tpu.util import state
+
+        deadline = time.monotonic() + 20
+        seen = set()
+        while time.monotonic() < deadline:
+            for sample in state.get_metrics_timeseries(limit=200):
+                for series in sample.get("series", ()):
+                    seen.add(series.get("name"))
+            if {"object_spilled_total", "object_restored_total"} <= seen:
+                break
+            time.sleep(0.5)
+        assert "object_spilled_total" in seen, sorted(seen)
+        assert "object_restored_total" in seen, sorted(seen)
+    finally:
+        _teardown_split_cluster(procs, sessions, saved)
+
+
+def test_spill_delete_deregisters_and_pull_falls_through():
+    """Satellite: freeing a spill-backed copy must deregister its GCS
+    location exactly like eviction does — a later pull for the object
+    skips the stale holder and lands on the next one."""
+    procs, gcs, sessions, saved = _start_split_cluster(
+        [
+            {"name": "node-a", "num_cpus": 1},
+            {"name": "node-b", "num_cpus": 1, "resources": {"b": 1}},
+        ],
+        extra_env=_SPILL_ENV,
+    )
+    ray_tpu.init(address=gcs, _node_name="node-a")
+    try:
+        want = np.random.default_rng(23).integers(
+            0, 255, size=1024 * 1024, dtype=np.uint8
+        )
+
+        @ray_tpu.remote(resources={"b": 1})
+        def produce():
+            import numpy as _np
+
+            return _np.random.default_rng(23).integers(
+                0, 255, size=1024 * 1024, dtype=_np.uint8
+            )
+
+        ref = produce.remote()
+        got = ray_tpu.get(ref, timeout=120)  # node-a now holds a SECONDARY
+        np.testing.assert_array_equal(got, want)
+        core = _core()
+        b_addr = _raylet_addr_of(core, "node-b")
+        oid_hex = ref.id.hex()
+
+        # wait until both holders are registered (node-b's spill sweep
+        # also lands its spill metadata)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            locs = _locations(core, oid_hex)
+            if {h["node_id"] for h in locs} >= {"node-a", "node-b"}:
+                break
+            time.sleep(0.2)
+        assert {h["node_id"] for h in locs} >= {"node-a", "node-b"}, locs
+
+        # free the (spilled) primary on node-b -> its location entry,
+        # spill registration included, must go
+        async def free_on_b():
+            conn = await core._conn_to(b_addr, kind="raylet")
+            return await conn.call(
+                "free_objects", oids_hex=[oid_hex], timeout=30
+            )
+
+        assert core.io.run(free_on_b(), timeout=60)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            locs = _locations(core, oid_hex)
+            if all(h["node_id"] != "node-b" for h in locs):
+                break
+            time.sleep(0.2)
+        assert all(h["node_id"] != "node-b" for h in locs), locs
+        assert any(h["node_id"] == "node-a" for h in locs), locs
+
+        # a fresh pull on node-b consults the location table: the stale
+        # self-entry is gone, so it falls through to node-a's copy
+        sealed_nbytes = next(
+            h["nbytes"] for h in locs if h["node_id"] == "node-a"
+        )
+
+        async def pull_back():
+            conn = await core._conn_to(b_addr, kind="raylet")
+            return await conn.call(
+                "pull_object", oid_hex=oid_hex, source_addr=None,
+                nbytes=sealed_nbytes, timeout=120,
+            )
+
+        reply = core.io.run(pull_back(), timeout=120)
+        assert reply.get("ok"), reply
+
+        @ray_tpu.remote(resources={"b": 1})
+        def checksum(x):
+            return int(x.sum())
+
+        assert ray_tpu.get(checksum.remote(ref), timeout=120) == \
+            int(want.sum())
+    finally:
+        _teardown_split_cluster(procs, sessions, saved)
+
+
+@pytest.mark.chaos(timeout=240)
+def test_kill_primary_holder_spill_adoption_restores_bytes():
+    """Dead-node restore: SIGKILL the raylet holding the ONLY in-memory/
+    spilled copy while the owner's ref is live. The GCS death path hands
+    the dead node's spill files to a surviving raylet; the owner's get()
+    re-anchors to the adopter and lands byte-identical content. The
+    producing resource dies with the node, so lineage CANNOT save this —
+    only spill adoption can."""
+    procs, gcs, sessions, saved = _start_split_cluster(
+        [
+            {"name": "node-a", "num_cpus": 1},
+            {"name": "node-b", "num_cpus": 1, "resources": {"b": 1}},
+            {"name": "node-c", "num_cpus": 1, "resources": {"c": 1}},
+        ],
+        extra_env=_SPILL_ENV,
+    )
+    ray_tpu.init(address=gcs, _node_name="node-a")
+    try:
+        rng_seed = 29
+        want = np.random.default_rng(rng_seed).integers(
+            0, 255, size=1024 * 1024, dtype=np.uint8
+        )
+
+        @ray_tpu.remote(resources={"b": 1})
+        def produce(seed):
+            import numpy as _np
+
+            return _np.random.default_rng(seed).integers(
+                0, 255, size=1024 * 1024, dtype=_np.uint8
+            )
+
+        ref = produce.remote(rng_seed)
+        ray_tpu.wait([ref], timeout=60)
+        core = _core()
+        b_addr = _raylet_addr_of(core, "node-b")
+        oid_hex = ref.id.hex()
+
+        # wait for the spill sweep to persist the primary AND register
+        # its spill metadata — the only thing that survives the SIGKILL
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            locs = _locations(core, oid_hex)
+            if any(h["node_id"] == "node-b" and h["spilled"] for h in locs):
+                break
+            time.sleep(0.2)
+        assert any(h["node_id"] == "node-b" and h["spilled"]
+                   for h in locs), locs
+
+        # SIGKILL the primary holder (procs[0] is the GCS)
+        procs.procs[2].kill()
+        procs.procs[2].wait(timeout=10)
+
+        # health-check death (~5s) -> adoption: a holder OTHER than
+        # node-b appears in the location table
+        deadline = time.monotonic() + 60
+        adopted = []
+        while time.monotonic() < deadline:
+            locs = _locations(core, oid_hex)
+            adopted = [h for h in locs if h["node_id"] != "node-b"]
+            if adopted:
+                break
+            time.sleep(0.5)
+        assert adopted, f"no surviving raylet adopted the spill: {locs}"
+
+        got = ray_tpu.get(ref, timeout=120)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        _teardown_split_cluster(procs, sessions, saved)
+
+
+@pytest.mark.chaos(timeout=240)
+def test_kill_primary_holder_falls_back_to_lineage():
+    """Dead-node last resort: the holder dies BEFORE any spill/secondary
+    exists (default spill threshold, cold loop never ran). No copy
+    survives anywhere, so the owner must fall to lineage reconstruction —
+    the task re-executes on the surviving node with the same resource —
+    and get() still lands byte-identical. Never a hang."""
+    procs, gcs, sessions, saved = _start_split_cluster([
+        {"name": "node-a", "num_cpus": 1},
+        {"name": "node-b", "num_cpus": 1, "resources": {"w": 1, "b": 1}},
+        {"name": "node-c", "num_cpus": 1, "resources": {"w": 1}},
+    ])
+    ray_tpu.init(address=gcs, _node_name="node-a")
+    try:
+        want = np.random.default_rng(31).integers(
+            0, 255, size=512 * 1024, dtype=np.uint8
+        )
+
+        # resources={"b": 1} pins the FIRST execution to node-b; the
+        # retry spec only needs "w", which node-c also offers
+        @ray_tpu.remote(resources={"w": 0.5})
+        def produce(seed):
+            import numpy as _np
+
+            return _np.random.default_rng(seed).integers(
+                0, 255, size=512 * 1024, dtype=_np.uint8
+            )
+
+        @ray_tpu.remote(resources={"b": 1})
+        def block():
+            return True
+
+        # occupy node-b... actually pin production by occupying node-c's
+        # "w" first so produce lands on node-b deterministically
+        @ray_tpu.remote(resources={"w": 1})
+        def hold_w(sec):
+            import time as _t
+
+            _t.sleep(sec)
+            return True
+
+        holders = [hold_w.remote(4.0), hold_w.remote(4.0)]
+        time.sleep(0.5)  # both w-nodes briefly saturated
+        del holders
+        ref = produce.remote(31)
+        ray_tpu.wait([ref], timeout=60)
+        core = _core()
+        loc = core.locations.get(ref.id)
+        assert loc is not None
+        victim = loc["node_id"]
+        assert victim in ("node-b", "node-c"), loc
+        victim_idx = {"node-b": 2, "node-c": 3}[victim]
+
+        procs.procs[victim_idx].kill()
+        procs.procs[victim_idx].wait(timeout=10)
+
+        t0 = time.monotonic()
+        got = ray_tpu.get(ref, timeout=180)
+        np.testing.assert_array_equal(got, want)
+        assert time.monotonic() - t0 < 170, "get() nearly hung"
+    finally:
+        _teardown_split_cluster(procs, sessions, saved)
+
+
+def test_pin_keeps_primary_under_pull_pressure():
+    """End-to-end pinning: with the store too small for everything, owner-
+    pinned primaries spill (never drop) while unpinned secondary pull
+    caches evict first — and every live ref still gets byte-identical
+    data back."""
+    procs, gcs, sessions, saved = _start_split_cluster([
+        {"name": "node-a", "num_cpus": 1, "store_mb": 3},
+        {"name": "node-b", "num_cpus": 1, "resources": {"b": 1}},
+    ])
+    ray_tpu.init(address=gcs, _node_name="node-a")
+    try:
+        @ray_tpu.remote(resources={"b": 1})
+        def produce(fill):
+            return np.full(1024 * 1024, fill, dtype=np.uint8)
+
+        refs = [produce.remote(i) for i in range(5)]
+        for i, ref in enumerate(refs):  # pull everything through node-a
+            assert ray_tpu.get(ref, timeout=120)[0] == i
+        core = _core()
+        st = _store_stats(core)
+        assert st["used_bytes"] <= st["capacity_bytes"], st
+        assert st["num_evicted"] >= 1, st
+        # every ref is still readable and correct after the pressure
+        for i, ref in enumerate(refs):
+            got = ray_tpu.get(ref, timeout=120)
+            assert got[0] == i and got.nbytes == 1024 * 1024
+    finally:
+        _teardown_split_cluster(procs, sessions, saved)
