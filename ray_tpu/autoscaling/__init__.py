@@ -1,0 +1,36 @@
+"""Closed-loop elasticity: SLO-driven autoscaling.
+
+Two tiers close the loop end to end:
+
+- **Replica tier** (L3, the Serve deployment autoscaler): the controller's
+  :class:`~ray_tpu.autoscaling.engine.AutoscaleEngine` evaluates a pure
+  target-tracking :class:`~ray_tpu.autoscaling.policy.ReplicaScalingPolicy`
+  over the GCS metrics *time series* (QPS, per-replica ongoing, queue-wait
+  percentiles, shed rate) on its own thread, checkpoints every scale
+  decision into the durable head KV *before* actuation, and retires
+  replicas through the graceful drain protocol in
+  :mod:`~ray_tpu.autoscaling.drain` (stop admitting → finish in-flight →
+  kill). Scale-to-zero and scale-from-zero are first-class: a cold request
+  queues at the router behind admission while the policy wakes a replica,
+  and the wait is recorded as ``serve_cold_start_ms``.
+
+- **Node tier** (L4, the cluster autoscaler):
+  :class:`~ray_tpu.autoscaling.engine.NodeTier` grows the fleet through a
+  :class:`~ray_tpu.autoscaler.node_provider.NodeProvider` while leases
+  queue or shapes are infeasible, and drains idle nodes (primaries
+  proactively spilled so dead-node spill adoption keeps them readable)
+  before terminating them. The owned-node set checkpoints into the same
+  durable KV so a restarted head re-adopts the resized fleet.
+
+Parity: Ray Serve's autoscaling_policy.py (replica tier) + the L4
+autoscaler/StandardAutoscaler (node tier), fused over this repo's metrics
+and durability planes.
+"""
+
+from ray_tpu.autoscaling.policy import (  # noqa: F401
+    DeploymentSignals,
+    ReplicaScalingPolicy,
+    collect_signals,
+)
+from ray_tpu.autoscaling.drain import DrainCoordinator  # noqa: F401
+from ray_tpu.autoscaling.engine import AutoscaleEngine, NodeTier  # noqa: F401

@@ -1,0 +1,206 @@
+"""Graceful replica drain: stop admitting → finish in-flight → kill.
+
+Parity: serve/_private/deployment_state.py replica STOPPING with
+``graceful_shutdown_timeout_s``. The controller decides a replica must go
+(scale-down, fleet-wide circuit ejection, deployment delete); instead of
+an immediate kill that fails its in-flight requests over to survivors, it
+hands the replica to the :class:`DrainCoordinator`:
+
+1. the replica leaves the routing table (version bump — routers stop
+   sending NEW requests within one refresh) and is told to
+   ``prepare_drain`` (its own admission gate starts refusing typed, the
+   defense-in-depth half for routers with a stale table);
+2. a dedicated drain thread polls ``num_ongoing_requests`` until the
+   replica is idle — or ``serve_drain_deadline_s`` expires — then kills
+   it and counts ``serve_drained_total``;
+3. the chaos point ``replica.drain`` fires at the DRAINING transition, so
+   a plan can SIGKILL the replica mid-drain deterministically: its
+   in-flight requests must resolve through the router failover plane
+   typed, never as an untyped error.
+
+The coordinator never runs on the controller's reconcile thread — drain
+polls block (bounded) and reconcile must not.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.analysis import sanitizers as _san
+from ray_tpu.core.config import _config
+
+logger = logging.getLogger(__name__)
+
+
+class _Draining:
+    __slots__ = ("actor", "deployment", "rkey", "deadline", "since", "on_done")
+
+    def __init__(self, actor, deployment: str, rkey: bytes,
+                 deadline: float, on_done):
+        self.actor = actor
+        self.deployment = deployment
+        self.rkey = rkey
+        self.deadline = deadline     # monotonic force-kill time
+        self.since = time.monotonic()
+        self.on_done = on_done
+
+
+class DrainCoordinator:
+    """Owns every replica currently DRAINING, cluster-role-agnostic: the
+    controller submits, the drain thread retires. ``kill_fn`` is injected
+    for tests (defaults to ``ray_tpu.kill``)."""
+
+    def __init__(self, kill_fn: Optional[Callable[[Any], None]] = None,
+                 poll_interval_s: float = 0.1):
+        self._kill_fn = kill_fn
+        self._poll = poll_interval_s
+        self._items: Dict[bytes, _Draining] = {}
+        self._lock = _san.make_lock("autoscaling.drain")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._drained_metric: Any = None
+        self.drained_count = 0          # total retired (tests/status)
+        self.deadline_kills = 0         # force-killed at the deadline
+
+    # ----------------------------------------------------------- submission
+    def submit(self, deployment: str, actor, rkey: bytes,
+               on_done: Optional[Callable[[bytes], None]] = None,
+               deadline_s: Optional[float] = None) -> None:
+        """Begin draining one replica. The caller has ALREADY removed it
+        from the routing table (and bumped the version); this side stops
+        replica-side admission and schedules the idle/deadline kill."""
+        from ray_tpu.testing import chaos
+
+        key_hex = rkey.hex() if isinstance(rkey, (bytes, bytearray)) else str(rkey)
+        act = chaos.fire("replica.drain", key=f"{deployment}:{key_hex}")
+        if act is not None and act.get("action") == "kill":
+            # SIGKILL mid-drain: in-flight requests die with the process
+            # and must fail over typed through the router plane
+            logger.warning(
+                "CHAOS: killing DRAINING replica of %r before its "
+                "in-flight requests finish", deployment,
+            )
+            self._kill(actor)
+            if on_done is not None:
+                on_done(rkey)
+            return
+        try:
+            actor.prepare_drain.remote()
+        except Exception:  # noqa: BLE001 - racing replica death
+            pass
+        deadline = time.monotonic() + (
+            deadline_s if deadline_s is not None
+            else _config.serve_drain_deadline_s
+        )
+        with self._lock:
+            self._items[rkey] = _Draining(
+                actor, deployment, rkey, deadline, on_done
+            )
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="serve-drain"
+                )
+                self._thread.start()
+
+    def pending(self, deployment: Optional[str] = None) -> int:
+        with self._lock:
+            if deployment is None:
+                return len(self._items)
+            return sum(
+                1 for d in self._items.values()
+                if d.deployment == deployment
+            )
+
+    def draining_keys(self, deployment: str) -> List[str]:
+        with self._lock:
+            return [
+                d.rkey.hex() for d in self._items.values()
+                if d.deployment == deployment
+            ]
+
+    def stop(self) -> None:
+        """Shutdown: force-kill everything still draining (an explicit
+        serve.shutdown doesn't owe in-flight requests a graceful exit)."""
+        self._stop.set()
+        with self._lock:
+            items, self._items = list(self._items.values()), {}
+        for d in items:
+            self._kill(d.actor)
+
+    # ---------------------------------------------------------- drain thread
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                items = list(self._items.values())
+            if not items:
+                continue
+            now = time.monotonic()
+            for d in items:
+                done = now >= d.deadline
+                forced = done
+                if not done:
+                    done = self._is_idle(d)
+                if not done:
+                    continue
+                with self._lock:
+                    if self._items.pop(d.rkey, None) is None:
+                        continue  # raced stop()
+                self._kill(d.actor)
+                self.drained_count += 1
+                if forced:
+                    self.deadline_kills += 1
+                    logger.warning(
+                        "drain deadline (%.1fs) hit for a replica of %r: "
+                        "force-killed with requests possibly in flight",
+                        _config.serve_drain_deadline_s, d.deployment,
+                    )
+                else:
+                    logger.info(
+                        "replica of %r drained idle in %.2fs and retired",
+                        d.deployment, now - d.since,
+                    )
+                self._count_drained(d.deployment)
+                if d.on_done is not None:
+                    try:
+                        d.on_done(d.rkey)
+                    except Exception:  # noqa: BLE001 - callback is best-effort
+                        logger.exception("drain on_done callback failed")
+
+    def _is_idle(self, d: _Draining) -> bool:
+        """One bounded liveness/idleness probe. A dead/unreachable replica
+        counts as drained — there is nothing left to wait for."""
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(
+                d.actor.num_ongoing_requests.remote(), timeout=2
+            ) <= 0
+        except ray_tpu.exceptions.GetTimeoutError:
+            return False  # alive but slow: keep waiting toward the deadline
+        except Exception:  # noqa: BLE001 - already dead
+            return True
+
+    def _kill(self, actor) -> None:
+        import ray_tpu
+
+        kill = self._kill_fn or ray_tpu.kill
+        try:
+            kill(actor)
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+
+    def _count_drained(self, deployment: str) -> None:
+        if not _config.metrics_enabled:
+            return
+        if self._drained_metric is None:
+            from ray_tpu.util import metrics as m
+
+            self._drained_metric = m.Counter(
+                "serve_drained_total",
+                "replicas retired through the graceful drain protocol",
+                tag_keys=("deployment",),
+            )
+        self._drained_metric.inc(1.0, {"deployment": deployment})

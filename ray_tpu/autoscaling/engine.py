@@ -1,0 +1,387 @@
+"""Autoscale engines: the replica tier (serve) and the node tier (cluster).
+
+**AutoscaleEngine** is the controller-side replica tier. It runs the
+:class:`~ray_tpu.autoscaling.policy.ReplicaScalingPolicy` on its OWN
+thread (the old ``_autoscale`` blocked the reconcile thread on a 10 s
+``ray_tpu.get`` fan-out — deploys and health probes stalled for the whole
+window), reading the GCS metrics time series instead of RPCing replicas.
+Every changed target is checkpointed into the durable head KV *before*
+actuation: a controller SIGKILLed between "decided to scale" and "fleet
+matches" restores the decided targets on restart and the reconcile ticker
+resumes converging — scale decisions are never lost with the process.
+
+**NodeTier** is the L4 cluster tier: a demand-driven loop over the
+existing :class:`~ray_tpu.autoscaler.autoscaler.StandardAutoscaler`
+policy, with two additions. Terminations go through a draining provider —
+the leaving node's raylet pre-spills its PRIMARY copies (``drain_node``
+rpc) so dead-node spill adoption keeps them readable byte-identical after
+the process exits — and both directions emit ``autoscaler_nodes`` /
+``autoscaler_scale_events_total`` so the dashboard charts fleet size. The
+chaos point ``node.drain`` fires at the drain decision: a plan can skip
+the graceful pre-spill deterministically and prove the recovery path
+alone keeps the bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.core.config import _config
+from ray_tpu.autoscaling.policy import (
+    POLICY_METRICS,
+    ReplicaScalingPolicy,
+    collect_signals,
+)
+
+logger = logging.getLogger(__name__)
+
+# durable ownership records (both tiers ride the PR-14 head KV/WAL)
+SCALE_NS = "serve"
+SCALE_KEY = "scale_targets"
+NODES_NS = "autoscaler"
+NODES_KEY = "nodes"
+
+
+def fetch_policy_samples() -> List[dict]:
+    """Default metrics source: the bounded GCS time-series window the
+    policy reads (only the series it uses — one small payload per tick)."""
+    from ray_tpu.util import state
+
+    window = max(2, int(
+        _config.serve_autoscale_window_s * 1000.0
+        / max(_config.metrics_report_interval_ms, 1)
+    ))
+    try:
+        return state.get_metrics_timeseries(
+            names=POLICY_METRICS, limit=window
+        ) or []
+    except Exception:  # noqa: BLE001 - metrics outage must not stop scaling
+        logger.exception("autoscale metrics fetch failed")
+        return []
+
+
+class AutoscaleEngine:
+    """Replica-tier engine. Wired through callables so it is testable
+    without a controller:
+
+    - ``snapshot() -> [(name, autoscaling_config, target, running), ...]``
+    - ``apply({name: new_target})`` — in-memory commit + reconcile nudge
+    - ``checkpoint({name: target})`` — durable write of the FULL target
+      map; raising aborts this tick's apply (durability before actuation)
+    - ``fetch_samples() -> samples`` — metrics window (default: GCS ring)
+    """
+
+    def __init__(self, *, snapshot: Callable[[], Sequence[Tuple]],
+                 apply: Callable[[Dict[str, int]], None],
+                 checkpoint: Optional[Callable[[Dict[str, int]], None]] = None,
+                 fetch_samples: Optional[Callable[[], List[dict]]] = None,
+                 policy: Optional[ReplicaScalingPolicy] = None,
+                 interval_s: Optional[float] = None):
+        self._snapshot = snapshot
+        self._apply = apply
+        self._checkpoint = checkpoint
+        self._fetch = fetch_samples or fetch_policy_samples
+        self.policy = policy or ReplicaScalingPolicy()
+        self._interval = (
+            interval_s if interval_s is not None
+            else _config.serve_autoscale_interval_s
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._target_gauge: Any = None
+        self.ticks = 0
+        self.scale_events = 0
+
+    def start(self) -> "AutoscaleEngine":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-autoscale"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(max(0.05, self._interval)):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("autoscale tick failed")
+
+    def tick(self) -> Dict[str, int]:
+        """One policy evaluation; returns the targets that changed."""
+        rows = list(self._snapshot())
+        targets = {name: tgt for name, _ac, tgt, _run in rows}
+        auto = [r for r in rows if r[1] is not None]
+        changed: Dict[str, int] = {}
+        if auto:
+            samples = self._fetch()
+            for name, ac, current, running in auto:
+                sig = collect_signals(samples, name)
+                new = self.policy.decide(name, ac, current, running, sig)
+                if new != current:
+                    logger.info(
+                        "autoscale %s: %d -> %d (qps=%s ongoing=%s "
+                        "shed=%s)", name, current, new,
+                        None if sig.qps is None else round(sig.qps, 2),
+                        sig.ongoing,
+                        None if sig.shed_rate is None
+                        else round(sig.shed_rate, 2),
+                    )
+                    changed[name] = new
+                    targets[name] = new
+        if changed:
+            if self._checkpoint is not None:
+                # durable BEFORE actuation: raising skips the apply — the
+                # fleet never runs ahead of what a restart would restore
+                self._checkpoint(dict(targets))
+            self._apply(changed)
+            self.scale_events += len(changed)
+        self._publish_targets(targets)
+        self.ticks += 1
+        return changed
+
+    def _publish_targets(self, targets: Dict[str, int]) -> None:
+        if not _config.metrics_enabled:
+            return
+        if self._target_gauge is None:
+            from ray_tpu.util import metrics as m
+
+            self._target_gauge = m.Gauge(
+                "serve_replica_target",
+                "autoscale-policy target replicas per deployment",
+                tag_keys=("deployment",),
+            )
+        for name, tgt in targets.items():
+            self._target_gauge.set(float(tgt), {"deployment": name})
+
+
+# --------------------------------------------------------------- node tier
+def drain_node_via_driver(node_id: str) -> int:
+    """Graceful half of node scale-down: ask the leaving node's raylet to
+    pre-spill its PRIMARY copies (``drain_node``) so its objects are
+    disk-backed before the process dies and spill adoption is a pure file
+    handoff. Best-effort: a node that won't answer still gets terminated
+    and the normal dead-node recovery ladder covers it."""
+    try:
+        from ray_tpu.api import _global_worker
+
+        core = getattr(_global_worker().backend, "core", None)
+    except Exception:  # noqa: BLE001 - not initialized / local mode
+        return 0
+    if core is None:
+        return 0
+    try:
+        view = core.io.run(
+            core.gcs.call("get_resource_view", timeout=10), timeout=30
+        )
+        addr = ((view or {}).get(node_id) or {}).get("address")
+        if not addr:
+            return 0
+
+        async def q():
+            conn = await core._conn_to(addr, kind="raylet")
+            if conn is None:
+                return 0
+            return await conn.call("drain_node", timeout=15)
+
+        return int(core.io.run(q(), timeout=30) or 0)
+    except Exception:  # noqa: BLE001 - drain is best-effort by contract
+        logger.warning("node %s graceful pre-spill failed", node_id,
+                       exc_info=True)
+        return 0
+
+
+class _DrainingProvider:
+    """NodeProvider wrapper: every termination drains first. The chaos
+    point ``node.drain`` fires at the decision — a ``kill`` action skips
+    the graceful pre-spill so tests exercise the adopt-after-unclean-death
+    path deterministically."""
+
+    def __init__(self, inner, drain_fn: Callable[[str], Any],
+                 on_terminate: Optional[Callable[[str], None]] = None):
+        self.inner = inner
+        self._drain_fn = drain_fn
+        self._on_terminate = on_terminate
+
+    def create_node(self, resources=None) -> str:
+        return self.inner.create_node(resources)
+
+    def non_terminated_nodes(self) -> List[str]:
+        return self.inner.non_terminated_nodes()
+
+    def terminate_node(self, node_id: str) -> None:
+        from ray_tpu.testing import chaos
+
+        act = chaos.fire("node.drain", key=node_id)
+        if act is not None and act.get("action") == "kill":
+            logger.warning(
+                "CHAOS: terminating node %s WITHOUT the graceful "
+                "pre-spill", node_id,
+            )
+        else:
+            try:
+                spilled = self._drain_fn(node_id)
+                if spilled:
+                    logger.info(
+                        "node %s drained: %s primaries pre-spilled",
+                        node_id, spilled,
+                    )
+            except Exception:  # noqa: BLE001 - drain must not block leave
+                logger.exception("node %s drain hook failed", node_id)
+        self.inner.terminate_node(node_id)
+        if self._on_terminate is not None:
+            self._on_terminate(node_id)
+
+
+class NodeTier:
+    """Demand-driven node join/leave over a NodeProvider.
+
+    Wraps the :class:`StandardAutoscaler` policy (queued lease bundles,
+    pending actors and unfit ``request_resources`` shapes grow the fleet;
+    idle nodes leave after ``autoscaler_idle_timeout_s``) with graceful
+    drain on the way down, fleet-size metrics, and a durable ownership
+    checkpoint (``ns=autoscaler key=nodes``) so a restarted head knows
+    which nodes the tier manages."""
+
+    def __init__(self, provider, gcs_call, *,
+                 min_nodes: Optional[int] = None,
+                 max_nodes: Optional[int] = None,
+                 upscale_delay_s: Optional[float] = None,
+                 idle_timeout_s: Optional[float] = None,
+                 poll_interval_s: Optional[float] = None,
+                 node_resources: Optional[Dict[str, float]] = None,
+                 drain_fn: Optional[Callable[[str], Any]] = None,
+                 kv_call: Optional[Callable[..., Any]] = None):
+        self._kv_call = kv_call
+        self._provider = _DrainingProvider(
+            provider, drain_fn or drain_node_via_driver,
+            on_terminate=self._node_down,
+        )
+        self._auto = StandardAutoscaler(
+            self._provider, gcs_call,
+            min_workers=(min_nodes if min_nodes is not None
+                         else _config.autoscaler_min_nodes),
+            max_workers=(max_nodes if max_nodes is not None
+                         else _config.autoscaler_max_nodes),
+            upscale_delay_s=(upscale_delay_s if upscale_delay_s is not None
+                             else _config.autoscaler_upscale_delay_s),
+            idle_timeout_s=(idle_timeout_s if idle_timeout_s is not None
+                            else _config.autoscaler_idle_timeout_s),
+            node_resources=node_resources,
+            poll_period_s=(poll_interval_s if poll_interval_s is not None
+                           else _config.autoscaler_poll_interval_s),
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._nodes_gauge: Any = None
+        self._events_counter: Any = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    # -------------------------------------------------------------- control
+    @property
+    def events(self) -> List[str]:
+        return self._auto.events
+
+    def owned_nodes(self) -> List[str]:
+        return self._provider.non_terminated_nodes()
+
+    def request_resources(self, bundles: List[Dict[str, float]]) -> None:
+        self._auto.request_resources(bundles)
+
+    def start(self) -> "NodeTier":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="node-tier"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(max(0.05, self._auto.poll_period_s)):
+            try:
+                self.reconcile()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("node tier reconcile failed")
+
+    # --------------------------------------------------------------- policy
+    def reconcile(self) -> None:
+        before = set(self._provider.non_terminated_nodes())
+        self._auto.reconcile()
+        after = set(self._provider.non_terminated_nodes())
+        for _ in after - before:
+            self.scale_ups += 1
+            self._count_event("up")
+        self._publish(len(after))
+        self._checkpoint_nodes(sorted(after))
+
+    def _node_down(self, node_id: str) -> None:
+        self.scale_downs += 1
+        self._count_event("down")
+
+    # ---------------------------------------------------------- durability
+    def _checkpoint_nodes(self, nodes: List[str]) -> None:
+        """Best-effort durable ownership record: which nodes this tier
+        manages, so a restarted head (GCS WAL restore) re-adopts the
+        RESIZED fleet's accounting instead of forgetting tier launches."""
+        if self._kv_call is None:
+            return
+        try:
+            self._kv_call(
+                "kv_put", ns=NODES_NS, key=NODES_KEY,
+                value=json.dumps(nodes).encode(),
+            )
+        except Exception:  # noqa: BLE001 - accounting, not correctness
+            pass
+
+    @staticmethod
+    def restore_owned(kv_call) -> List[str]:
+        """Read back the durable ownership record (empty when absent)."""
+        try:
+            blob = kv_call("kv_get", ns=NODES_NS, key=NODES_KEY)
+            if not blob:
+                return []
+            if isinstance(blob, bytes):
+                blob = blob.decode()
+            return list(json.loads(blob))
+        except Exception:  # noqa: BLE001 - corrupt/missing record
+            return []
+
+    # -------------------------------------------------------------- metrics
+    def _publish(self, n: int) -> None:
+        if not _config.metrics_enabled:
+            return
+        if self._nodes_gauge is None:
+            from ray_tpu.util import metrics as m
+
+            self._nodes_gauge = m.Gauge(
+                "autoscaler_nodes",
+                "nodes the cluster-autoscaler tier currently manages",
+            )
+        self._nodes_gauge.set(float(n))
+
+    def _count_event(self, direction: str) -> None:
+        if not _config.metrics_enabled:
+            return
+        if self._events_counter is None:
+            from ray_tpu.util import metrics as m
+
+            self._events_counter = m.Counter(
+                "autoscaler_scale_events_total",
+                "node-tier scale actuations by direction",
+                tag_keys=("direction",),
+            )
+        self._events_counter.inc(1.0, {"direction": direction})

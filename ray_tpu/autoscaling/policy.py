@@ -1,0 +1,192 @@
+"""Replica-tier scaling policy: pure target tracking over metric samples.
+
+Parity: serve/_private/autoscaling_policy.py (`_calculate_desired_num_
+replicas`) — but fed from the GCS metrics *time series* instead of a
+blocking per-replica RPC fan-out. The controller's engine hands the policy
+a window of merged snapshots (``get_metrics_timeseries``); the policy
+derives QPS (``counter_rate`` of ``serve_requests_total``), live ongoing
+requests (``serve_replica_ongoing`` gauge), queue-wait percentiles
+(DDSketch-backed ``window_percentile``) and the shed rate, then tracks
+``target_ongoing_requests`` per replica with hysteresis and asymmetric
+up/down cooldowns. Everything here is deterministic and cluster-free:
+``decide()`` is a pure function of (signals, state, clock), which is what
+the unit tests drive directly.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ray_tpu.core.config import _config
+from ray_tpu.util.metrics import _find_points, counter_rate, window_percentile
+
+# every series the replica-tier policy reads; the engine fetches exactly
+# these names so a policy tick moves one bounded payload off the GCS
+POLICY_METRICS = [
+    "serve_requests_total",
+    "serve_replica_ongoing",
+    "serve_queue_wait_ms",
+    "serve_shed_total",
+    "raylet_pending_leases",
+    "object_spilled_bytes",
+]
+
+
+@dataclass
+class DeploymentSignals:
+    """One deployment's demand picture over the sampled window. ``None``
+    means the series never appeared (no traffic yet / metrics off) — the
+    policy treats missing demand as zero demand, never as an error."""
+
+    qps: Optional[float] = None            # request arrival rate at routers
+    ongoing: Optional[float] = None        # executing now, summed over fleet
+    queue_wait_p90_ms: Optional[float] = None
+    shed_rate: Optional[float] = None      # typed sheds/s (admission + replica)
+
+
+def _gauge_latest(samples: List[dict], name: str,
+                  tags: Optional[Dict[str, str]] = None) -> Optional[float]:
+    """Newest summed value of a gauge series over every tag combination
+    that is a superset of ``tags`` (same selection rule as counter_rate),
+    scanning newest-first so a deployment that just went quiet still reads
+    its latest report, not an average over history."""
+    want = set((tags or {}).items())
+    for sample in reversed(samples or []):
+        for s in sample.get("series", ()):
+            if s.get("name") != name:
+                continue
+            acc = None
+            for ptags, val in s.get("points", {}).items():
+                if isinstance(val, list) or not want <= set(ptags):
+                    continue
+                acc = val if acc is None else acc + val
+            if acc is not None:
+                return acc
+    return None
+
+
+def _arrival_rate(samples: List[dict], name: str,
+                  tags: Dict[str, str]) -> Optional[float]:
+    """``counter_rate``, plus the zero-origin case it cannot see: a series
+    whose FIRST appearance is inside the window (a deployment that never
+    took traffic before) holds one constant level, so first→last delta is
+    zero — yet those arrivals are exactly the scale-from-zero signal. When
+    the series starts after the window does, read it as a 0 → v ramp."""
+    rate = counter_rate(samples, name, tags)
+    if rate:
+        return rate
+    seen = [
+        (s["ts"], v) for s in samples or []
+        for v in (_find_points(s, name, tags)[1],) if v is not None
+    ]
+    if not seen:
+        return rate
+    t_start = (samples[0].get("ts") or 0.0)
+    (t0, _v0), (t1, v1) = seen[0], seen[-1]
+    if t0 > t_start and t1 > t_start and v1 > 0:
+        return v1 / max(t1 - t_start, 1e-9)
+    return rate
+
+
+def collect_signals(samples: List[dict],
+                    deployment: str) -> DeploymentSignals:
+    """Derive one deployment's signals from a metrics-time-series window."""
+    tags = {"deployment": deployment}
+    return DeploymentSignals(
+        qps=_arrival_rate(samples, "serve_requests_total", tags),
+        ongoing=_gauge_latest(samples, "serve_replica_ongoing", tags),
+        queue_wait_p90_ms=window_percentile(
+            samples, "serve_queue_wait_ms", 0.9, tags
+        ),
+        shed_rate=counter_rate(samples, "serve_shed_total", tags),
+    )
+
+
+class ReplicaScalingPolicy:
+    """Target tracking with hysteresis + cooldowns + scale-to/from-zero.
+
+    Decisions per deployment:
+
+    - **up** when the fleet's ongoing-per-replica exceeds
+      ``target_ongoing_requests`` (or requests are being shed), at most
+      once per ``upscale_delay_s``, jumping straight to
+      ``ceil(ongoing / target_ongoing)`` so a step load converges in one
+      cooldown instead of N;
+    - **down** one replica at a time when ongoing-per-replica sits under
+      half the target (the hysteresis band — between half and full target
+      nothing moves), at most once per ``downscale_delay_s``;
+    - **to zero** only when ``min_replicas == 0`` and the deployment saw
+      zero arrivals AND zero ongoing for a full ``downscale_delay_s``;
+    - **from zero** the moment arrivals appear (cold requests are already
+      queued at routers — waiting out the upscale delay would only add
+      cold-start latency; gate with ``serve_autoscale_zero_wake=False``).
+    """
+
+    def __init__(self, now=time.monotonic):
+        self._now = now
+        self._last_up: Dict[str, float] = {}
+        self._last_down: Dict[str, float] = {}
+        self._quiet_since: Dict[str, float] = {}
+
+    def forget(self, deployment: str) -> None:
+        """Deployment deleted: drop its cooldown/quiet state."""
+        self._last_up.pop(deployment, None)
+        self._last_down.pop(deployment, None)
+        self._quiet_since.pop(deployment, None)
+
+    def decide(self, deployment: str, ac, current_target: int,
+               running: int, sig: DeploymentSignals) -> int:
+        """New target replica count (may equal ``current_target``)."""
+        now = self._now()
+        qps = sig.qps or 0.0
+        ongoing = sig.ongoing or 0.0
+        shed = sig.shed_rate or 0.0
+        per_replica_target = max(ac.target_ongoing_requests, 1e-9)
+
+        # ---- scale from zero: arrivals against an empty fleet
+        if current_target == 0:
+            if qps > 0 or ongoing > 0 or shed > 0:
+                if _config.serve_autoscale_zero_wake or (
+                    now - self._last_up.get(deployment, -1e18)
+                    >= ac.upscale_delay_s
+                ):
+                    self._quiet_since.pop(deployment, None)
+                    self._last_up[deployment] = now
+                    return max(1, ac.min_replicas)
+            return 0
+
+        avg = ongoing / max(running, 1)
+
+        # ---- scale up: tracking error above target, or typed sheds (the
+        # queue is already refusing work — capacity, not latency, is short)
+        overloaded = avg > per_replica_target or shed > 0
+        if overloaded and current_target < ac.max_replicas:
+            if now - self._last_up.get(deployment, -1e18) >= ac.upscale_delay_s:
+                desired = math.ceil(ongoing / per_replica_target)
+                if shed > 0:
+                    desired = max(desired, current_target + 1)
+                target = min(max(desired, current_target + 1), ac.max_replicas)
+                self._quiet_since.pop(deployment, None)
+                self._last_up[deployment] = now
+                return target
+            return current_target
+
+        # ---- scale to zero: a full downscale window of dead silence
+        if ac.min_replicas == 0 and qps <= 0 and ongoing <= 0:
+            quiet = self._quiet_since.setdefault(deployment, now)
+            if now - quiet >= ac.downscale_delay_s and current_target > 0:
+                self._last_down[deployment] = now
+                return 0
+            return current_target
+        self._quiet_since.pop(deployment, None)
+
+        # ---- scale down: below the hysteresis band, one step per cooldown
+        if avg < per_replica_target / 2 and current_target > ac.min_replicas:
+            if (now - self._last_down.get(deployment, -1e18)
+                    >= ac.downscale_delay_s):
+                self._last_down[deployment] = now
+                return max(current_target - 1, ac.min_replicas, 1)
+        return current_target

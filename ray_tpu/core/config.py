@@ -299,6 +299,45 @@ class Config:
     # (0 = slow-call detection off)
     serve_circuit_slow_call_ms: float = 0.0
 
+    # routers that must agree a replica is circuit-open (each reports its
+    # local breaker transitions to the controller) before the controller
+    # ejects it FLEET-WIDE: kills the replica and starts a replacement.
+    # One flaky router can't decimate a healthy fleet; 0 disables
+    # aggregate ejection entirely (reports stay operator-visible only).
+    serve_circuit_eject_quorum: int = 2
+
+    # --- serve autoscaling (ray_tpu/autoscaling/) ---------------------------
+    # how often the controller's autoscale engine evaluates the policy
+    # (its OWN thread — the reconcile loop never blocks on metrics reads)
+    serve_autoscale_interval_s: float = 1.0
+    # metrics-time-series window the policy reads (QPS, ongoing, queue
+    # wait, shed rate are computed over the last window_s of samples)
+    serve_autoscale_window_s: float = 30.0
+    # a deployment at zero replicas with arrival traffic in the window
+    # scales to one immediately (ignoring upscale_delay_s): cold requests
+    # are already queued at routers, waiting out a delay only adds latency
+    serve_autoscale_zero_wake: bool = True
+    # graceful drain: a replica marked DRAINING stops admitting (routers
+    # drop it on the next routing-table version), finishes in-flight
+    # requests, and is killed when idle — or force-killed at this deadline
+    serve_drain_deadline_s: float = 10.0
+    # regression bound asserted by tests: the reconcile loop must never
+    # stall longer than this between ticks (the old _autoscale blocked it
+    # on a 10s ray_tpu.get; the engine thread must not regress this)
+    serve_reconcile_max_stall_s: float = 5.0
+
+    # --- cluster autoscaler node tier (autoscaling/engine.py NodeTier) ------
+    # demand-driven node loop poll period
+    autoscaler_poll_interval_s: float = 1.0
+    # node-count bounds the tier converges within
+    autoscaler_min_nodes: int = 0
+    autoscaler_max_nodes: int = 4
+    # one node launch per this window while unserved demand persists
+    autoscaler_upscale_delay_s: float = 1.0
+    # a tier-launched node with no leases/pending work this long drains
+    # (primaries proactively spilled for spill-adoption) and leaves
+    autoscaler_idle_timeout_s: float = 30.0
+
     # --- serve fast-path dispatch (compiled/transport plane) ----------------
     # steady-state unary serve traffic dispatches over router-managed
     # compiled channels (cgraph shm/NetChannel) instead of per-request task

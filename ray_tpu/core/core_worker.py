@@ -769,14 +769,6 @@ class CoreWorker:
                     await conn.call_batched(
                         "free_objects", oids_hex=items, timeout=30
                     )
-            elif kind == "pin":
-                # owner → holder raylet: renew the pin lease on primaries
-                # this worker still holds live references to (the raylet
-                # applies its configured TTL; a crashed owner simply stops
-                # renewing and the pins age out)
-                conn = await self._conn_to(target, kind="raylet")
-                if conn is not None:
-                    await conn.notify_batched("pin_objects", entries=items)
             elif kind == "release_borrow":
                 conn = await self._conn_to(target, kind="worker")
                 if conn is not None:
@@ -789,24 +781,55 @@ class CoreWorker:
             logger.exception("metadata batch flush failed (%s)", kind)
 
     async def _pin_renew_loop(self) -> None:
-        """Owner side of primary pinning: every renew interval, queue a
-        batched pin renewal to each raylet holding a primary this worker
-        owns live references to. Rides the same metadata batch plane as
-        object_added/free — one rpc per raylet per flush, nothing on the
-        put/get hot paths. When this process dies the renewals stop and
-        the raylet-side leases expire, so pins can never wedge eviction."""
+        """Owner side of primary pinning: every renew interval, send a
+        batched pin renewal DIRECTLY to each raylet holding a primary this
+        worker owns live references to — one rpc per raylet per sweep,
+        nothing on the put/get hot paths. Renewals deliberately do NOT ride
+        the metadata batch plane: its fire-and-forget flush swallows
+        RpcError/ConnectionLost, and for an otherwise-idle owner (a quiet
+        driver holding pins, generating no other metadata traffic) a
+        silently-dropped batch was a missed renewal with nothing behind it
+        to paper over the gap — leases aged out under a live owner. Here
+        each send is awaited with its own quick retry and a logged failure.
+        When this process dies the renewals stop and the raylet-side
+        leases expire, so pins can never wedge eviction."""
         period = max(0.2, _config.object_pin_renew_interval_s)
         while True:
             await asyncio.sleep(period)
             try:
+                by_raylet: Dict[str, List[str]] = {}
                 for oid, loc in list(self.locations.items()):
                     if oid.binary() not in self._owned:
                         continue
                     addr = (loc or {}).get("raylet_addr")
                     if addr:
-                        self._queue_meta("pin", addr, oid.hex())
+                        by_raylet.setdefault(addr, []).append(oid.hex())
+                for addr, entries in by_raylet.items():
+                    await self._send_pin_renewals(addr, entries)
             except Exception:  # noqa: BLE001 - bookkeeping must never kill io
                 logger.exception("pin renewal sweep failed")
+
+    async def _send_pin_renewals(self, addr: str, entries: List[str]) -> None:
+        """One awaited renewal batch to one raylet, with a single quick
+        retry over a fresh connection (the common transient is a severed
+        cached conn). A final failure is LOGGED — the leases survive until
+        TTL, so the next sweep usually lands — never silently dropped."""
+        for attempt in (0, 1):
+            try:
+                conn = await self._conn_to(addr, kind="raylet")
+                if conn is None:
+                    return
+                await conn.notify_batched("pin_objects", entries=entries)
+                return
+            except (rpc.RpcError, rpc.ConnectionLost):
+                if attempt:
+                    logger.warning(
+                        "pin renewal to %s failed twice; %d lease(s) ride "
+                        "on the next sweep (TTL still covers them)",
+                        addr, len(entries),
+                    )
+                else:
+                    await asyncio.sleep(0.05)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not self.events.enabled():

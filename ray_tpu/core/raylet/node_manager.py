@@ -1397,6 +1397,23 @@ class Raylet:
                 n += 1
         return n
 
+    async def handle_drain_node(self, conn):
+        """Node-tier scale-down prelude: spill EVERY in-memory primary to
+        disk before this node is terminated, so the objects survive as
+        GCS-registered spill files and dead-node spill adoption (or a
+        lineage-free restore) serves them byte-identical after the process
+        is gone. Runs on an executor thread like the pressure spill loop —
+        the io loop keeps answering health checks mid-drain. Returns the
+        number of records spilled."""
+        loop = asyncio.get_running_loop()
+        # target_used=0: spill until no in-memory primary remains
+        n = await loop.run_in_executor(None, self.directory.spill_cold, 0)
+        logger.warning(
+            "drain_node: pre-spilled %d primary object(s) ahead of "
+            "termination", n,
+        )
+        return n
+
     def handle_promote_primary(self, conn, oids_hex):
         """GCS death path: this node's SECONDARY copies of a dead node's
         primaries become the authoritative PRIMARY copies (lifecycle

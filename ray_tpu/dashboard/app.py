@@ -172,6 +172,18 @@ async function slo() {
   if (xfer.length) html += spark("transfer", xfer, "MB/s");
   const pin = pts(samples, "pull_inflight_bytes").map(p => p.v / 1e6);
   if (pin.length) html += spark("pull inflight", pin, "MB");
+  // elasticity (autoscaling): decided targets vs live replicas, the wake
+  // latency scale-to-zero callers paid, and the node tier's fleet size
+  const tgt = pts(samples, "serve_replica_target").map(p => p.v);
+  if (tgt.length) html += spark("replica target", tgt, "");
+  const live = pts(samples, "serve_replica_ongoing").map(p => p.v);
+  if (live.length) html += spark("replicas ongoing", live, "");
+  const cold = pctl(samples, "serve_cold_start_ms", 0.99);
+  if (cold.length) html += spark("cold start p99", cold, "ms");
+  const drained = rate(pts(samples, "serve_drained_total"));
+  if (drained.length) html += spark("drains", drained, "/s");
+  const fleet = pts(samples, "autoscaler_nodes").map(p => p.v);
+  if (fleet.length) html += spark("autoscaler nodes", fleet, "");
   document.getElementById("slo").innerHTML =
     html || "(no SLO series yet)";
 }

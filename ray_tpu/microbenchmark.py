@@ -63,6 +63,9 @@ EXPECTED_ROWS: List[str] = [
     "object broadcast 4 pullers (origin serves)",
     "object spill to disk (MB/s)",
     "object restore from spill (MB/s)",
+    "autoscale policy decide (ops/s)",
+    "autoscale engine tick, 8 deployments (ops/s)",
+    "drain submit->retire roundtrip (ops/s)",
 ]
 
 
@@ -275,12 +278,101 @@ def main(duration: float = 2.0, json_path: str = "", smoke: bool = False):
     # ------------------------------------------------- spill / restore
     _lifecycle_benchmarks(results, smoke)
 
+    # --------------------------------------------------------- elasticity
+    _elasticity_benchmarks(results, smoke)
+
     payload = {"microbenchmark": results}
     print(json.dumps(payload))
     if json_path:
         with open(json_path, "w") as f:
             json.dump(payload, f, indent=2)
     return results
+
+
+def _elasticity_benchmarks(results, smoke: bool = False):
+    """Autoscaling control-plane costs, cluster-free: the pure policy
+    decision, a full engine tick over a synthetic 8-deployment metrics
+    window (signal extraction + decide + target publish), and the drain
+    coordinator's submit→retire roundtrip. These bound how fast the
+    replica tier can react — the loop runs every second, so a tick must be
+    orders of magnitude cheaper than its own period."""
+    import threading
+
+    from ray_tpu.autoscaling.drain import DrainCoordinator
+    from ray_tpu.autoscaling.engine import AutoscaleEngine
+    from ray_tpu.autoscaling.policy import (
+        DeploymentSignals, ReplicaScalingPolicy,
+    )
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    duration = 0.05 if smoke else 1.0
+    ac = AutoscalingConfig(min_replicas=0, max_replicas=8,
+                           target_ongoing_requests=2.0,
+                           upscale_delay_s=0.0, downscale_delay_s=0.0)
+    clock = [0.0]
+    policy = ReplicaScalingPolicy(now=lambda: clock[0])
+    sig = DeploymentSignals(qps=100.0, ongoing=12.0, shed_rate=0.0)
+
+    def decide():
+        n = 500
+        for _ in range(n):
+            clock[0] += 1.0
+            policy.decide("bench", ac, 2, 2, sig)
+        return n
+
+    results.append(timeit("autoscale policy decide (ops/s)", decide,
+                          duration))
+
+    deps = [f"dep{i}" for i in range(8)]
+
+    def mk_sample(ts, reqs):
+        return {"ts": ts, "series": [
+            {"name": "serve_requests_total", "kind": "counter",
+             "boundaries": [],
+             "points": {(("deployment", d),): reqs for d in deps}},
+            {"name": "serve_replica_ongoing", "kind": "gauge",
+             "boundaries": [],
+             "points": {(("deployment", d),): 12.0 for d in deps}},
+        ]}
+
+    window = [mk_sample(0.0, 0.0), mk_sample(1.0, 100.0)]
+    engine = AutoscaleEngine(
+        snapshot=lambda: [(d, ac, 2, 2) for d in deps],
+        apply=lambda targets: None,
+        fetch_samples=lambda: window,
+        policy=ReplicaScalingPolicy(now=lambda: clock[0]),
+        interval_s=3600.0,
+    )
+
+    def tick():
+        n = 100
+        for _ in range(n):
+            clock[0] += 1.0
+            engine.tick()
+        return n
+
+    results.append(timeit("autoscale engine tick, 8 deployments (ops/s)",
+                          tick, duration))
+
+    # drain roundtrip: fake actors (no cluster) retire through the dead-
+    # replica fast path; measures the coordinator's own handoff overhead
+    def drain_roundtrip():
+        n = 20
+        dc = DrainCoordinator(kill_fn=lambda a: None, poll_interval_s=0.001)
+        done = threading.Event()
+        seen = []
+        def on_done(rkey):
+            seen.append(rkey)
+            if len(seen) >= n:
+                done.set()
+        for i in range(n):
+            dc.submit("bench", object(), bytes([i]), on_done=on_done)
+        done.wait(10)
+        dc.stop()
+        return n
+
+    results.append(timeit("drain submit->retire roundtrip (ops/s)",
+                          drain_roundtrip, duration))
 
 
 def _cross_node_benchmarks(ray_tpu, results, duration: float):

@@ -340,6 +340,137 @@ def render_metrics_snapshot(samples) -> str:
     return "\n".join(lines) + "\n"
 
 
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals, width=24) -> str:
+    """Tiny block-character chart of a numeric series (None = gap). Scaled
+    to the window's own max so shape, not magnitude, reads at a glance."""
+    vals = list(vals)[-width:]
+    present = [v for v in vals if v is not None]
+    if not present:
+        return "-" * min(width, max(len(vals), 1))
+    top = max(max(present), 1e-9)
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(" ")
+        else:
+            out.append(_SPARK[min(len(_SPARK) - 1,
+                                  int(round(v / top * (len(_SPARK) - 1))))])
+    return "".join(out)
+
+
+def _gauge_track(samples, name, tags=None) -> list:
+    """Per-sample summed gauge values over the window (None where the
+    series is absent) — the input shape sparklines want."""
+    want = set((tags or {}).items())
+    track = []
+    for sample in samples or []:
+        acc = None
+        for s in sample.get("series", ()):
+            if s.get("name") != name:
+                continue
+            for ptags, val in s.get("points", {}).items():
+                if isinstance(val, list) or not want <= set(ptags):
+                    continue
+                acc = val if acc is None else acc + val
+        track.append(acc)
+    return track
+
+
+def render_autoscale_snapshot(samples) -> str:
+    """Elasticity view over the metrics time series: per-deployment target
+    vs running replicas (with sparklines over the window), cold-start
+    latency, drain totals, and the node tier's fleet size. Pure function of
+    get_metrics_timeseries output so tests can assert on it."""
+    from ray_tpu.util.metrics import counter_rate, window_percentile
+
+    lines = []
+    if not samples:
+        return "(no metric samples yet)\n"
+
+    def latest(track):
+        for v in reversed(track):
+            if v is not None:
+                return v
+        return None
+
+    # deployments seen on any elasticity-relevant series in the window
+    deployments = set()
+    for sample in samples:
+        for s in sample.get("series", ()):
+            if s.get("name") in ("serve_replica_target",
+                                 "serve_replica_ongoing",
+                                 "serve_requests_total"):
+                for tags in s.get("points", {}):
+                    deployments.update(
+                        v for k, v in tags if k == "deployment")
+    header = (f"{'deployment':<20s} {'target':>6s} {'ongoing':>8s} "
+              f"{'qps':>8s} {'cold p99':>9s} {'drained/s':>9s}  "
+              f"{'target over window':<24s}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for dep in sorted(deployments):
+        tags = {"deployment": dep}
+        tgt_track = _gauge_track(samples, "serve_replica_target", tags)
+        ongoing = latest(_gauge_track(samples, "serve_replica_ongoing",
+                                      tags))
+        qps = counter_rate(samples, "serve_requests_total", tags)
+        cold = window_percentile(samples, "serve_cold_start_ms", 0.99, tags)
+        drained = counter_rate(samples, "serve_drained_total", tags)
+        lines.append(
+            f"{dep:<20s} {_fmt_num(latest(tgt_track)):>6s} "
+            f"{_fmt_num(ongoing):>8s} {_fmt_num(qps):>8s} "
+            f"{_fmt_num(cold):>9s} {_fmt_num(drained):>9s}  "
+            f"{_sparkline(tgt_track):<24s}"
+        )
+    if not deployments:
+        lines.append("(no serve deployments reporting)")
+    # node tier: fleet size + scale-event rates by direction
+    node_track = _gauge_track(samples, "autoscaler_nodes")
+    if any(v is not None for v in node_track):
+        lines.append("")
+        parts = [f"nodes={_fmt_num(latest(node_track))}",
+                 f"[{_sparkline(node_track)}]"]
+        for direction in ("up", "down"):
+            r = counter_rate(samples, "autoscaler_scale_events_total",
+                             {"direction": direction})
+            if r:
+                parts.append(f"{direction}/s={r:,.2f}")
+        lines.append("node tier: " + "  ".join(parts))
+    pending = latest(_gauge_track(samples, "raylet_pending_leases"))
+    if pending:
+        lines.append(f"pending leases: {pending:,.0f}")
+    return "\n".join(lines) + "\n"
+
+
+def cmd_autoscale(args) -> int:
+    """Elasticity view: replica targets vs running (sparklines over the
+    window), cold starts, drain totals, and node-tier fleet size; --watch
+    refreshes in place. Same transport options as `scripts metrics`."""
+    import time as _time
+
+    if not args.dashboard:
+        _connect(args)
+        from ray_tpu.util import state
+
+    rounds = args.count if args.watch else 1
+    i = 0
+    while rounds <= 0 or i < rounds:
+        if args.dashboard:
+            samples = _fetch_timeseries_http(args.dashboard, args.window)
+        else:
+            samples = state.get_metrics_timeseries(limit=args.window)
+        if args.watch and sys.stdout.isatty():
+            print("\x1b[2J\x1b[H", end="")
+        print(render_autoscale_snapshot(samples), end="", flush=True)
+        i += 1
+        if rounds <= 0 or i < rounds:
+            _time.sleep(args.interval)
+    return 0
+
+
 def samples_from_dashboard_json(data) -> list:
     """Convert ``/api/timeseries`` JSON (points as ``[{"tags", "value"}]``
     lists) back into the internal sample shape (points keyed by sorted tag
@@ -585,6 +716,24 @@ def main(argv=None) -> int:
     p.add_argument("--window", type=int, default=30,
                    help="how many ring samples the rates/percentiles span")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "autoscale", help="elasticity view (replica targets vs running, "
+        "cold starts, drains, node-tier fleet size)",
+    )
+    p.add_argument("--address")
+    p.add_argument("--dashboard",
+                   help="dashboard address (host:port or http://...): read "
+                        "/api/timeseries over HTTP instead of connecting a "
+                        "driver to the cluster")
+    p.add_argument("--watch", action="store_true",
+                   help="refresh continuously")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--count", type=int, default=0,
+                   help="with --watch: stop after N refreshes (0 = forever)")
+    p.add_argument("--window", type=int, default=30,
+                   help="how many ring samples the view spans")
+    p.set_defaults(fn=cmd_autoscale)
 
     p = sub.add_parser(
         "lint", help="run raylint (RT001-RT007 static analysis) over the "

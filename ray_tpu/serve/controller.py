@@ -6,9 +6,13 @@ STARTING → RUNNING → STOPPING, dead replicas replaced). Runs as a detached
 named actor; handles/proxies pull the routing table by version (the
 long-poll LongPollHost analog, long_poll.py:186).
 
-Autoscaling: replica-reported ongoing-request counts drive the target count
-between min/max (autoscaling_policy.py analog), evaluated each reconcile
-tick.
+Autoscaling: the :class:`~ray_tpu.autoscaling.engine.AutoscaleEngine` runs
+the target-tracking policy on its OWN thread over the GCS metrics time
+series (autoscaling_policy.py analog) — the reconcile ticker never blocks
+on a per-replica RPC fan-out — checkpoints every decided target into the
+durable head KV *before* actuation, and retires surplus replicas through
+the graceful drain protocol (routing-table eviction → finish in-flight →
+kill) instead of an immediate SIGKILL.
 """
 
 from __future__ import annotations
@@ -57,6 +61,12 @@ REPLICA_STARTUP_GRACE_S = 60.0
 CHECKPOINT_NS = "serve"
 CHECKPOINT_KEY = "deployments"
 
+# autoscale DECISIONS get their own durable record (same KV namespace): a
+# controller SIGKILLed between "decided to scale" and "fleet converged"
+# restores the decided targets, not the deploy-time defaults, so the fleet
+# resumes converging where the dead controller left off
+SCALE_TARGETS_KEY = "scale_targets"
+
 
 class ServeController:
     def __init__(self):
@@ -65,6 +75,11 @@ class ServeController:
         # name → replica key hex → breaker state routers reported
         # ("open"/"half_open"; closed entries are removed)
         self._circuit_states: Dict[str, Dict[str, str]] = {}
+        # aggregate circuit view: name → replica key hex → set of router
+        # ids currently reporting that replica OPEN. One router's breaker
+        # is local evidence; a quorum of routers seeing the same replica
+        # open is fleet-wide evidence and triggers ejection
+        self._circuit_reporters: Dict[str, Dict[str, set]] = {}
         self._version = 0
         self._lock = _san.make_lock("serve.controller.state")
         # serializes compute-targets + checkpoint save + in-memory commit:
@@ -83,6 +98,21 @@ class ServeController:
         # until fresh replicas sit PENDING forever
         self._reconcile_mutex = _san.make_lock("serve.controller.reconcile")
         self._stop = threading.Event()
+        # reconcile cadence forensics: the old in-loop _autoscale blocked
+        # this thread up to 10 s per deployment; status() now exposes the
+        # observed tick stalls so the regression is testable
+        self._reconcile_ticks = 0
+        self._max_reconcile_stall_s = 0.0
+        # graceful retirement + the replica-tier scaling engine (its OWN
+        # thread — the reconcile ticker never waits on metrics or policy)
+        from ray_tpu.autoscaling import AutoscaleEngine, DrainCoordinator
+
+        self._drain = DrainCoordinator()
+        self._engine = AutoscaleEngine(
+            snapshot=self._autoscale_snapshot,
+            apply=self._apply_scale_targets,
+            checkpoint=self._save_scale_targets,
+        ).start()
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
@@ -140,6 +170,7 @@ class ServeController:
         except Exception:  # noqa: BLE001 - corrupt checkpoint: start empty
             logger.exception("serve checkpoint decode failed")
             return
+        scale_targets = self._load_scale_targets()
         with self._lock:
             for dep in deployments:
                 self._deployments[dep.name] = dep
@@ -148,11 +179,48 @@ class ServeController:
                     dep.autoscaling_config.min_replicas
                     if dep.autoscaling_config else dep.num_replicas
                 )
+                # overlay the last DECIDED autoscale target (clamped to the
+                # deployment's current bounds): a controller killed
+                # mid-scale-up resumes converging toward the decision it
+                # already checkpointed, not the deploy-time floor
+                ac = dep.autoscaling_config
+                if ac is not None and dep.name in scale_targets:
+                    decided = int(scale_targets[dep.name])
+                    rs.target = min(max(decided, ac.min_replicas),
+                                    ac.max_replicas)
         if deployments:
             logger.warning(
                 "serve controller restored %d deployment target(s) from "
-                "the durable checkpoint", len(deployments),
+                "the durable checkpoint%s", len(deployments),
+                " (+ decided autoscale targets)" if scale_targets else "",
             )
+
+    def _load_scale_targets(self) -> Dict[str, int]:
+        import json
+
+        try:
+            blob = self._kv_call(
+                "kv_get", ns=CHECKPOINT_NS, key=SCALE_TARGETS_KEY
+            )
+            if not blob:
+                return {}
+            if isinstance(blob, bytes):
+                blob = blob.decode()
+            return dict(json.loads(blob))
+        except Exception:  # noqa: BLE001 - absent/corrupt: deploy defaults
+            return {}
+
+    def _save_scale_targets(self, targets: Dict[str, int]) -> None:
+        """Durable record of the engine's decided targets. Called by the
+        engine BEFORE it applies a changed target — raising aborts the
+        apply, so the live fleet never runs ahead of what a restarted
+        controller would restore."""
+        import json
+
+        self._kv_call(
+            "kv_put", ns=CHECKPOINT_NS, key=SCALE_TARGETS_KEY,
+            value=json.dumps(targets).encode(),
+        )
 
     # ------------------------------------------------------------ target API
     def deploy(self, deployment) -> bool:
@@ -190,8 +258,13 @@ class ServeController:
                 self._deployments.pop(name, None)
                 rs = self._replicas.pop(name, None)
                 self._circuit_states.pop(name, None)
+                self._circuit_reporters.pop(name, None)
+        self._engine.policy.forget(name)
         if rs:
-            self._stop_replicas(rs.actors)
+            # deletes drain too: in-flight requests against a deleted
+            # deployment finish (or hit the deadline) instead of dying
+            for a in rs.actors:
+                self._drain.submit(name, a, _replica_key(a))
         self._bump()
         return True
 
@@ -236,35 +309,80 @@ class ServeController:
 
     def status(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 name: {
                     "target": rs.target,
                     "running": len(rs.actors),
                     "circuit": dict(self._circuit_states.get(name, {})),
+                    "draining": self._drain.draining_keys(name),
                 }
                 for name, rs in self._replicas.items()
             }
+        out["_control"] = {
+            "reconcile_ticks": self._reconcile_ticks,
+            "max_reconcile_stall_s": self._max_reconcile_stall_s,
+            "autoscale_ticks": self._engine.ticks,
+            "autoscale_events": self._engine.scale_events,
+            "drained": self._drain.drained_count,
+            "drain_deadline_kills": self._drain.deadline_kills,
+        }
+        return out
 
     def report_replica_state(self, name: str, replica_key: bytes,
-                             state: str) -> bool:
+                             state: str, router_id: str = "") -> bool:
         """A router's circuit breaker transitioned for one of our replicas
         (open = ejected from that router's routing, closed = restored by a
-        half-open probe). Recorded for operators (status()); the replica
-        keeps running — breakers protect callers from slow/flaky replicas
-        the health check still passes, so killing it here would be wrong."""
+        half-open probe). One router's report is local evidence — recorded
+        for operators (status()) and nothing more, since breakers trip on
+        slow/flaky replicas the health check still passes. But when a
+        QUORUM of distinct routers (serve_circuit_eject_quorum, 0 disables)
+        holds the same replica open, that is fleet-wide evidence: the
+        replica is ejected from the routing table and gracefully drained;
+        the reconcile ticker starts a fresh replacement."""
+        from ray_tpu.core.config import _config
+
         key_hex = (
             replica_key.hex() if isinstance(replica_key, (bytes, bytearray))
             else str(replica_key)
         )
+        victims = []
         with self._lock:
             states = self._circuit_states.setdefault(name, {})
+            reporters = self._circuit_reporters.setdefault(name, {})
             if state == "closed":
                 states.pop(key_hex, None)
+                open_set = reporters.get(key_hex)
+                if open_set is not None:
+                    open_set.discard(router_id)
             else:
                 states[key_hex] = state
+                if state == "open" and router_id:
+                    open_set = reporters.setdefault(key_hex, set())
+                    open_set.add(router_id)
+                    quorum = _config.serve_circuit_eject_quorum
+                    if quorum > 0 and len(open_set) >= quorum:
+                        rs = self._replicas.get(name)
+                        if rs is not None:
+                            victims = [a for a in rs.actors
+                                       if _replica_key(a) == replica_key]
+                            for a in victims:
+                                rs.actors.remove(a)
+                                rs.born.pop(replica_key, None)
+                        if victims:
+                            reporters.pop(key_hex, None)
+                            states.pop(key_hex, None)
+        if victims:
+            self._drain.submit(name, victims[0], replica_key)
+            self._bump()
+            logger.warning(
+                "replica %s of %r EJECTED: %d routers report its circuit "
+                "open (quorum); draining, replacement next tick",
+                key_hex[:12], name, _config.serve_circuit_eject_quorum,
+            )
+            return True
         logger.warning(
-            "replica %s of %r circuit %s (router-reported)",
-            key_hex[:12], name, state,
+            "replica %s of %r circuit %s (router %s reported)",
+            key_hex[:12], name, state, router_id[:8] or "?",
         )
         return True
 
@@ -298,6 +416,8 @@ class ServeController:
 
     def shutdown(self) -> bool:
         self._stop.set()
+        self._engine.stop()
+        self._drain.stop()  # force-kills anything still draining
         with self._lock:
             self._deployments.clear()
         for rs in self._replicas.values():
@@ -313,6 +433,9 @@ class ServeController:
                 self._kv_call(
                     "kv_del", ns=CHECKPOINT_NS, key=CHECKPOINT_KEY
                 )
+                self._kv_call(
+                    "kv_del", ns=CHECKPOINT_NS, key=SCALE_TARGETS_KEY
+                )
         except Exception:  # noqa: BLE001 - head already gone at teardown
             pass
         return True
@@ -322,12 +445,20 @@ class ServeController:
         self._version += 1
 
     def _reconcile_loop(self):
+        # NOTE: no _autoscale() here anymore — policy evaluation moved to
+        # the AutoscaleEngine's own thread. This loop only converges the
+        # fleet toward targets, and its tick duration is tracked so the
+        # "reconcile stalled behind autoscaling" regression stays dead.
         while not self._stop.wait(1.0):
+            t0 = time.monotonic()
             try:
-                self._autoscale()
                 self._reconcile()
             except Exception:  # noqa: BLE001 - loop must survive
                 logger.exception("serve reconcile error")
+            stall = time.monotonic() - t0
+            self._reconcile_ticks += 1
+            if stall > self._max_reconcile_stall_s:
+                self._max_reconcile_stall_s = stall
 
     def _reconcile(self):
         import ray_tpu
@@ -375,9 +506,14 @@ class ServeController:
                 rs.actors.append(new)
                 changed = True
             while len(rs.actors) > rs.target:
+                # graceful retirement: leave the routing table NOW (version
+                # bump below — routers stop sending within one refresh),
+                # finish in-flight inside the drain deadline, then die.
+                # The drain thread owns the kill; reconcile never waits.
                 extra = rs.actors.pop()
-                rs.born.pop(_replica_key(extra), None)
-                self._stop_replicas([extra])
+                rkey = _replica_key(extra)
+                rs.born.pop(rkey, None)
+                self._drain.submit(name, extra, rkey)
                 changed = True
         if changed:
             self._bump()
@@ -420,36 +556,36 @@ class ServeController:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _autoscale(self):
-        import ray_tpu
-
+    # ------------------------------------------------------- autoscale hooks
+    # The engine thread calls these three; none of them RPC replicas (the
+    # policy reads the GCS metrics time series), so the only shared cost is
+    # the state lock — the old 10 s num_ongoing_requests fan-out that could
+    # stall a reconcile tick for the whole window is gone. Deployments with
+    # ZERO running replicas are still snapshotted (the old loop skipped
+    # `not rs.actors`, which made scale-from-zero structurally impossible:
+    # no replicas → no report → no scale-up, forever).
+    def _autoscale_snapshot(self):
         with self._lock:
-            items = list(self._deployments.items())
+            return [
+                (name, dep.autoscaling_config,
+                 self._replicas[name].target
+                 if name in self._replicas else 0,
+                 len(self._replicas[name].actors)
+                 if name in self._replicas else 0)
+                for name, dep in self._deployments.items()
+            ]
+
+    def _apply_scale_targets(self, changed: Dict[str, int]) -> None:
         now = time.monotonic()
-        for name, dep in items:
-            ac = dep.autoscaling_config
-            rs = self._replicas.get(name)
-            if ac is None or rs is None or not rs.actors:
-                continue
-            try:
-                ongoing = ray_tpu.get(
-                    [a.num_ongoing_requests.remote() for a in rs.actors],
-                    timeout=10,
-                )
-            except Exception:  # noqa: BLE001 - racing replica death
-                continue
-            avg = sum(ongoing) / max(len(ongoing), 1)
-            target = rs.target
-            if avg > ac.target_ongoing_requests and (
-                now - rs.last_scale_change > ac.upscale_delay_s
-            ):
-                target = min(rs.target + 1, ac.max_replicas)
-            elif avg < ac.target_ongoing_requests / 2 and (
-                now - rs.last_scale_change > ac.downscale_delay_s
-            ):
-                target = max(rs.target - 1, ac.min_replicas)
-            if target != rs.target:
-                logger.info("autoscale %s: %d -> %d (avg ongoing %.1f)",
-                            name, rs.target, target, avg)
-                rs.target = target
+        with self._lock:
+            for name, target in changed.items():
+                rs = self._replicas.get(name)
+                if rs is None or name not in self._deployments:
+                    continue  # deleted while the engine was deciding
+                logger.info("autoscale %s: %d -> %d", name, rs.target,
+                            target)
+                rs.target = int(target)
                 rs.last_scale_change = now
+        # converge now instead of waiting out the ticker (cold wake-ups
+        # shave up to a full tick off serve_cold_start_ms)
+        self._reconcile()

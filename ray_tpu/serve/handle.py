@@ -100,6 +100,13 @@ class _ServeMetrics:
             "replicas currently ejected by an open circuit breaker",
             tag_keys=dep,
         )
+        # ---- elasticity (scale-to-zero wake path) ----
+        self.cold_start = m.Histogram(
+            "serve_cold_start_ms",
+            "request arrival against ZERO live replicas -> first replica "
+            "available (the scale-from-zero wake latency the caller paid)",
+            boundaries=LATENCY_MS_BOUNDS, tag_keys=dep,
+        )
         # ---- fast-path dispatch (compiled/transport plane) ----
         self.fastpath_requests = m.Counter(
             "serve_fastpath_requests_total",
@@ -150,6 +157,10 @@ class _Breaker:
 class Router:
     def __init__(self, controller_handle):
         self._controller = controller_handle
+        # stable per-router identity for breaker reports: the controller
+        # counts DISTINCT routers holding a replica open, so a quorum of
+        # independent observers (not one router flapping) ejects fleet-wide
+        self._router_id = f"{random.getrandbits(48):012x}"
         self._version = -1
         self._replicas: Dict[str, List[Any]] = {}
         self._routes: Dict[str, str] = {}
@@ -363,9 +374,9 @@ class Router:
             "serve: circuit %s for a replica of %r", state.upper(), deployment
         )
         self._update_circuit_gauge(deployment)
-        try:  # best effort: the controller records it for operators
+        try:  # best effort: the controller aggregates per-router reports
             self._controller.report_replica_state.remote(
-                deployment, rkey, state
+                deployment, rkey, state, self._router_id
             )
         except Exception:  # noqa: BLE001 - observability only
             pass
@@ -849,11 +860,23 @@ class Router:
         within the request's own budget, never a hidden 30s."""
         self._refresh()
         wait_until = time.monotonic() + timeout
+        cold_since = None  # set on the first zero-replica observation
         while True:
             with self._lock:
                 replicas = list(self._replicas.get(deployment) or ())
             if replicas:
+                if cold_since is not None:
+                    # scale-from-zero wake: the time this caller spent
+                    # queued against an empty fleet IS the cold start
+                    sm = serve_metrics()
+                    if sm is not None:
+                        sm.cold_start.observe(
+                            (time.monotonic() - cold_since) * 1000.0,
+                            {"deployment": deployment},
+                        )
                 return replicas
+            if cold_since is None:
+                cold_since = time.monotonic()
             if deadline is not None and time.time() >= deadline:
                 raise exc.DeadlineExceededError(
                     f"request to {deployment!r} shed: deadline expired "

@@ -121,6 +121,12 @@ class ServeReplica:
         # legacy-protocol usage counter (tests assert the push-based serve
         # path issues ZERO per-chunk polling RPCs)
         self._legacy_polls = 0
+        # DRAINING: set by prepare_drain when the controller retires this
+        # replica. The routing-table eviction already stops new traffic at
+        # routers with a fresh table; this flag is the defense-in-depth
+        # half — a router on a STALE table gets a typed reject it can fail
+        # over, instead of work landing on a replica about to die.
+        self._draining = False
 
     def _m(self):
         from ray_tpu.core.config import _config
@@ -142,6 +148,13 @@ class ServeReplica:
         act = chaos.fire("replica.handle", key=self._chaos_key())
         if act is not None and act.get("action") == "delay":
             time.sleep(act.get("delay_s") or 0.2)
+        if self._draining:
+            from ray_tpu import exceptions as exc
+
+            raise exc.BackPressureError(
+                f"replica of {self._deployment_name!r} is draining "
+                "(retiring; route to a live replica)"
+            )
         if 0 < self._max_ongoing <= self._ongoing:
             self._sheds += 1
             m = self._m()
@@ -368,6 +381,18 @@ class ServeReplica:
     def num_ongoing_requests(self) -> int:
         return self._ongoing
 
+    def prepare_drain(self) -> bool:
+        """Controller-side retirement started: refuse NEW requests typed
+        (BackPressureError — routers fail it over like any shed) while
+        in-flight work finishes. The DrainCoordinator polls
+        ``num_ongoing_requests`` and kills this actor at idle/deadline."""
+        self._draining = True
+        return True
+
+    def drain_status(self) -> dict:
+        return {"draining": self._draining, "ongoing": self._ongoing,
+                "ongoing_streams": self._ongoing_streams}
+
     def stats(self) -> dict:
         return {
             "ongoing": self._ongoing,
@@ -375,6 +400,7 @@ class ServeReplica:
             "total": self._total,
             "legacy_polls": self._legacy_polls,
             "sheds": self._sheds,
+            "draining": self._draining,
         }
 
     def check_health(self) -> bool:

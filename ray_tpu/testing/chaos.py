@@ -39,6 +39,13 @@ point                 where it fires
                       delayed (``slow_replica``): deterministic
                       slow/degraded-replica injection driving the serve
                       circuit breaker
+``replica.drain``     ``autoscaling/drain.py`` — the Nth replica marked
+                      DRAINING is killed mid-drain; in-flight requests
+                      must fail over typed
+``node.drain``        ``autoscaling/engine.py`` — the Nth node selected
+                      to drain is terminated before its graceful
+                      pre-spill; spill adoption must still recover its
+                      primaries
 ``gcs.wal``           ``core/gcs/wal.py`` append — the GCS hard-exits
                       right after the Nth durable WAL record lands
                       (mutation durable, reply unsent; no pre-exit flush)
@@ -148,6 +155,22 @@ REGISTERED_POINTS: Dict[str, Dict[str, Any]] = {
                  "matching calls are delayed — deterministic slow-replica "
                  "injection driving the circuit breaker",
     },
+    "replica.drain": {
+        "module": "ray_tpu/autoscaling/drain.py",
+        "builders": ["kill_draining_replica"],
+        "where": "graceful-drain transition: the Nth replica marked "
+                 "DRAINING is killed mid-drain (before its in-flight "
+                 "requests finish), so routed failover must resolve them "
+                 "typed — never an untyped error or a hang",
+    },
+    "node.drain": {
+        "module": "ray_tpu/autoscaling/engine.py",
+        "builders": ["kill_draining_node"],
+        "where": "node-tier scale-down: the Nth node selected to drain is "
+                 "terminated immediately, SKIPPING the graceful "
+                 "pre-spill — its primaries must still survive through "
+                 "dead-node spill adoption / lineage",
+    },
     "object.pull": {
         "module": "ray_tpu/core/object_store/chunk_transfer.py",
         "builders": ["sever_pull"],
@@ -239,6 +262,25 @@ class ChaosPlan:
         the half-open probe restores it."""
         return self._rule("replica.handle", "delay", match=match, nth=nth,
                           repeat=True, times=times, delay_s=delay_s)
+
+    def kill_draining_replica(self, match: str = "", nth: int = 1,
+                              repeat: bool = False,
+                              times: int = 0) -> "ChaosPlan":
+        """Kill the Nth serve replica entering the DRAINING state whose key
+        (``deployment:replica-actor-id-hex``) contains ``match`` — a
+        SIGKILL mid-drain, before its in-flight requests finish. The
+        router's failover plane must resolve those requests typed (retry
+        on a healthy replica or a typed error), never untyped."""
+        return self._rule("replica.drain", "kill", match=match, nth=nth,
+                          repeat=repeat, times=times)
+
+    def kill_draining_node(self, match: str = "", nth: int = 1) -> "ChaosPlan":
+        """Terminate the Nth node the autoscaler tier selects to drain
+        whose node id contains ``match`` IMMEDIATELY, skipping the
+        graceful primaries pre-spill — the dead-node recovery path
+        (spill adoption / promotion / lineage) must keep every primary
+        that lived there readable byte-identical."""
+        return self._rule("node.drain", "kill", match=match, nth=nth)
 
     def kill_cgraph_actor(self, match: str = "",
                           after_iters: int = 1) -> "ChaosPlan":

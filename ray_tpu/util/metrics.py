@@ -204,6 +204,18 @@ KNOWN_METRICS: Dict[str, str] = {
                                      "node-loss WAL tails",
     # dev-mode runtime sanitizers (analysis/sanitizers.py)
     "sanitizer_violations_total": "sanitizer violations by kind",
+    # closed-loop elasticity (ray_tpu/autoscaling/)
+    "serve_replica_target": "autoscale-policy target replicas per "
+                            "deployment (controller-set gauge)",
+    "serve_cold_start_ms": "scale-from-zero cold start: request arrival "
+                           "at a zero-replica deployment -> first live "
+                           "replica admitted it",
+    "serve_drained_total": "replicas retired through the graceful drain "
+                           "protocol (in-flight finished, then killed)",
+    "autoscaler_nodes": "nodes the cluster-autoscaler node tier currently "
+                        "manages",
+    "autoscaler_scale_events_total": "node-tier scale actuations by "
+                                     "direction (up/down)",
 }
 
 

@@ -1,0 +1,855 @@
+"""Closed-loop elasticity (PR 18): SLO-driven autoscaling with graceful
+drain, scale-to-zero, durable scale decisions, and elastic cluster nodes.
+
+Layers under test:
+
+- unit: ReplicaScalingPolicy (pure function of signals + injected clock),
+  collect_signals over synthetic metric samples, AutoscaleEngine's
+  checkpoint-BEFORE-apply contract, NodeTier ownership records;
+- cluster: the full loop — load raises the metric-derived target, the
+  reconcile ticker grows the fleet, silence drains it back down through
+  the DrainCoordinator (never a mid-request kill), reconcile never stalls
+  on scaling, a SIGKILLed controller restores its DECIDED targets;
+- chaos: a replica SIGKILLed while DRAINING fails its in-flight requests
+  over typed; a node scale-down pre-spills primaries so spill adoption
+  keeps them byte-identical after the raylet is gone;
+- regression: an idle owner's pin-lease renewals ride a dedicated send
+  (not the batched meta queue) and keep a primary pinned across many TTLs.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+
+def _clock():
+    t = {"v": 1000.0}
+
+    def now():
+        return t["v"]
+
+    def advance(d):
+        t["v"] += d
+
+    return now, advance
+
+
+def _ac(**kw):
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    return AutoscalingConfig(**kw)
+
+
+# --------------------------------------------------------------- unit: policy
+def test_policy_step_load_converges_in_one_upscale_cooldown():
+    from ray_tpu.autoscaling import DeploymentSignals, ReplicaScalingPolicy
+
+    now, advance = _clock()
+    p = ReplicaScalingPolicy(now=now)
+    ac = _ac(min_replicas=1, max_replicas=8, target_ongoing_requests=2.0,
+             upscale_delay_s=5.0, downscale_delay_s=10.0)
+    # 12 ongoing over 2 replicas: jump straight to ceil(12/2)=6, not 3
+    sig = DeploymentSignals(qps=20.0, ongoing=12.0)
+    assert p.decide("d", ac, 2, 2, sig) == 6
+    # still overloaded but inside the cooldown: hold
+    assert p.decide("d", ac, 6, 2, sig) == 6
+    # converged (avg == target is NOT overloaded): hold after the cooldown
+    advance(5.0)
+    assert p.decide("d", ac, 6, 6, sig) == 6
+
+
+def test_policy_hysteresis_band_never_flaps():
+    from ray_tpu.autoscaling import DeploymentSignals, ReplicaScalingPolicy
+
+    now, advance = _clock()
+    p = ReplicaScalingPolicy(now=now)
+    ac = _ac(min_replicas=1, max_replicas=8, target_ongoing_requests=2.0,
+             upscale_delay_s=1.0, downscale_delay_s=1.0)
+    # avg 1.5 sits between target/2 (1.0) and target (2.0): nothing moves,
+    # no matter how many cooldowns elapse
+    sig = DeploymentSignals(qps=5.0, ongoing=9.0)
+    for _ in range(5):
+        assert p.decide("d", ac, 6, 6, sig) == 6
+        advance(2.0)
+
+
+def test_policy_scales_down_one_step_per_cooldown():
+    from ray_tpu.autoscaling import DeploymentSignals, ReplicaScalingPolicy
+
+    now, advance = _clock()
+    p = ReplicaScalingPolicy(now=now)
+    ac = _ac(min_replicas=1, max_replicas=8, target_ongoing_requests=2.0,
+             upscale_delay_s=1.0, downscale_delay_s=10.0)
+    sig = DeploymentSignals(qps=1.0, ongoing=1.0)  # avg stays < target/2
+    assert p.decide("d", ac, 4, 4, sig) == 3
+    # inside the down cooldown: hold (one step at a time, not a collapse)
+    assert p.decide("d", ac, 3, 3, sig) == 3
+    advance(10.0)
+    assert p.decide("d", ac, 3, 3, sig) == 2
+    # never below min_replicas
+    advance(10.0)
+    assert p.decide("d", ac, 2, 2, sig) == 1
+    advance(10.0)
+    assert p.decide("d", ac, 1, 1, sig) == 1
+
+
+def test_policy_scale_to_zero_needs_full_quiet_window_then_wakes():
+    from ray_tpu.autoscaling import DeploymentSignals, ReplicaScalingPolicy
+
+    now, advance = _clock()
+    p = ReplicaScalingPolicy(now=now)
+    ac = _ac(min_replicas=0, max_replicas=4, target_ongoing_requests=2.0,
+             upscale_delay_s=1.0, downscale_delay_s=10.0)
+    quiet = DeploymentSignals()  # series never appeared: zero demand
+    # silence starts the quiet clock but does NOT drop to zero yet
+    assert p.decide("d", ac, 1, 1, quiet) == 1
+    advance(9.0)
+    assert p.decide("d", ac, 1, 1, quiet) == 1
+    # a blip of traffic resets the quiet window
+    assert p.decide("d", ac, 1, 1, DeploymentSignals(qps=2.0, ongoing=1.0)) == 1
+    advance(9.0)
+    assert p.decide("d", ac, 1, 1, quiet) == 1
+    advance(10.0)
+    assert p.decide("d", ac, 1, 1, quiet) == 0
+    # arrivals against the empty fleet wake it immediately (zero_wake)
+    assert p.decide("d", ac, 0, 0, DeploymentSignals(qps=3.0)) == 1
+    # a min_replicas floor > 1 wakes to the floor, not to one replica
+    ac2 = _ac(min_replicas=2, max_replicas=4)
+    assert p.decide("e", ac2, 0, 0, DeploymentSignals(qps=3.0)) == 2
+
+
+def test_policy_shed_rate_forces_an_upscale_step():
+    from ray_tpu.autoscaling import DeploymentSignals, ReplicaScalingPolicy
+
+    now, _ = _clock()
+    p = ReplicaScalingPolicy(now=now)
+    ac = _ac(min_replicas=1, max_replicas=8, target_ongoing_requests=2.0,
+             upscale_delay_s=1.0, downscale_delay_s=10.0)
+    # ongoing alone says "fine" (avg 0.5) but requests are being SHED:
+    # the queue is refusing work, so capacity must grow anyway
+    sig = DeploymentSignals(qps=50.0, ongoing=1.0, shed_rate=4.0)
+    assert p.decide("d", ac, 2, 2, sig) == 3
+
+
+def test_collect_signals_reads_only_the_deployments_series():
+    from ray_tpu.autoscaling import collect_signals
+
+    def sample(ts, requests, ongoing):
+        return {
+            "ts": ts,
+            "series": [
+                {
+                    "name": "serve_requests_total", "kind": "counter",
+                    "points": {
+                        (("deployment", "d"),): requests,
+                        (("deployment", "other"),): 9999.0,
+                    },
+                },
+                {
+                    "name": "serve_replica_ongoing", "kind": "gauge",
+                    "points": {
+                        (("deployment", "d"), ("replica", "a")): ongoing,
+                        (("deployment", "other"), ("replica", "z")): 50.0,
+                    },
+                },
+            ],
+        }
+
+    samples = [sample(100.0, 10.0, 3.0), sample(102.0, 20.0, 5.0)]
+    sig = collect_signals(samples, "d")
+    assert sig.qps == pytest.approx(5.0)     # (20-10)/2s, "other" excluded
+    assert sig.ongoing == pytest.approx(5.0)  # newest gauge report
+    assert sig.queue_wait_p90_ms is None      # series absent -> None
+    assert sig.shed_rate is None
+    # a deployment with no points at all reads as "no demand", not an error
+    empty = collect_signals(samples, "ghost")
+    assert empty.qps in (None, 0.0) and empty.ongoing is None
+
+
+def test_collect_signals_first_ever_request_reads_as_arrivals():
+    """A counter whose FIRST appearance is inside the window holds one
+    constant level (1.0), so plain first→last rate is zero — but that one
+    request IS the scale-from-zero wake signal and must read as qps > 0."""
+    from ray_tpu.autoscaling import collect_signals
+
+    def sample(ts, series):
+        return {"ts": ts, "series": series}
+
+    req = {
+        "name": "serve_requests_total", "kind": "counter",
+        "points": {(("deployment", "d"),): 1.0},
+    }
+    samples = [
+        sample(100.0, []),            # window starts BEFORE any traffic
+        sample(100.2, []),
+        sample(100.4, [req]),         # the first request ever arrives...
+        sample(100.6, [req]),         # ...and the level then sits constant
+    ]
+    sig = collect_signals(samples, "d")
+    assert sig.qps is not None and sig.qps > 0
+    # but a level that was already there at the window start is history,
+    # not new arrivals: no phantom wake on a long-quiet deployment
+    flat = [sample(100.0, [req]), sample(100.6, [req])]
+    assert not collect_signals(flat, "d").qps
+
+
+# --------------------------------------------------------------- unit: engine
+class _StubPolicy:
+    def __init__(self, out):
+        self.out = out
+
+    def decide(self, name, ac, current, running, sig):
+        return self.out
+
+    def forget(self, name):
+        pass
+
+
+def test_engine_checkpoint_failure_aborts_the_apply():
+    from ray_tpu.autoscaling import AutoscaleEngine
+
+    ac = _ac(min_replicas=1, max_replicas=8)
+    applied = []
+
+    def bad_checkpoint(targets):
+        raise RuntimeError("durable KV down")
+
+    eng = AutoscaleEngine(
+        snapshot=lambda: [("d", ac, 1, 1)],
+        apply=lambda ch: applied.append(dict(ch)),
+        checkpoint=bad_checkpoint,
+        fetch_samples=lambda: [],
+        policy=_StubPolicy(3),
+        interval_s=3600,
+    )
+    # durability before actuation: if the decision can't be made durable,
+    # the fleet must NOT move (a restart would forget the scale-up)
+    with pytest.raises(RuntimeError):
+        eng.tick()
+    assert applied == []
+    assert eng.scale_events == 0
+
+
+def test_engine_checkpoints_full_target_map_before_apply():
+    from ray_tpu.autoscaling import AutoscaleEngine
+
+    ac = _ac(min_replicas=1, max_replicas=8)
+    order = []
+    eng = AutoscaleEngine(
+        snapshot=lambda: [("d", ac, 1, 1), ("plain", None, 2, 2)],
+        apply=lambda ch: order.append(("apply", dict(ch))),
+        checkpoint=lambda t: order.append(("ckpt", dict(t))),
+        fetch_samples=lambda: [],
+        policy=_StubPolicy(3),
+        interval_s=3600,
+    )
+    assert eng.tick() == {"d": 3}
+    # the checkpoint carries the FULL map (restore needs every deployment)
+    # and lands strictly before the in-memory commit
+    assert order == [("ckpt", {"d": 3, "plain": 2}), ("apply", {"d": 3})]
+    assert eng.scale_events == 1 and eng.ticks == 1
+
+
+def test_engine_no_change_means_no_checkpoint_write():
+    from ray_tpu.autoscaling import AutoscaleEngine
+
+    ac = _ac(min_replicas=1, max_replicas=8)
+    order = []
+    eng = AutoscaleEngine(
+        snapshot=lambda: [("d", ac, 2, 2)],
+        apply=lambda ch: order.append(("apply", dict(ch))),
+        checkpoint=lambda t: order.append(("ckpt", dict(t))),
+        fetch_samples=lambda: [],
+        policy=_StubPolicy(2),  # decides the current target
+        interval_s=3600,
+    )
+    assert eng.tick() == {}
+    assert order == []
+
+
+def test_engine_skips_metrics_fetch_without_autoscaled_deployments():
+    from ray_tpu.autoscaling import AutoscaleEngine
+
+    def boom():
+        raise AssertionError("fetch must not run for fixed deployments")
+
+    eng = AutoscaleEngine(
+        snapshot=lambda: [("plain", None, 2, 2)],
+        apply=lambda ch: None,
+        fetch_samples=boom,
+        interval_s=3600,
+    )
+    assert eng.tick() == {}
+
+
+def test_node_tier_ownership_record_roundtrip():
+    import json
+
+    from ray_tpu.autoscaling import NodeTier
+    from ray_tpu.autoscaling.engine import NODES_KEY, NODES_NS
+
+    store = {}
+
+    def kv(method, ns=None, key=None, value=None):
+        if method == "kv_put":
+            store[(ns, key)] = value
+            return True
+        if method == "kv_get":
+            return store.get((ns, key))
+        raise AssertionError(method)
+
+    assert NodeTier.restore_owned(kv) == []
+    kv("kv_put", ns=NODES_NS, key=NODES_KEY,
+       value=json.dumps(["node-a", "node-b"]).encode())
+    assert NodeTier.restore_owned(kv) == ["node-a", "node-b"]
+    # corrupt record reads as empty, never raises into the caller
+    kv("kv_put", ns=NODES_NS, key=NODES_KEY, value=b"{not json")
+    assert NodeTier.restore_owned(kv) == []
+
+
+# ------------------------------------------------------- cluster: closed loop
+@pytest.fixture
+def elastic_cluster():
+    """Real cluster with fast metric/scaling clocks. Env vars reach the
+    controller/replica/daemon processes (spawned after us); the direct
+    ``_config`` mutation covers this driver process, whose singleton was
+    built before the env override. Function-scoped on purpose: several
+    tests in this file tear the global runtime down and re-init, which a
+    module-scoped cluster cannot survive."""
+    import ray_tpu
+    from ray_tpu.core.config import _config
+
+    env = {
+        "RAY_TPU_METRICS_REPORT_INTERVAL_MS": "200",
+        "RAY_TPU_SERVE_AUTOSCALE_INTERVAL_S": "0.25",
+        "RAY_TPU_SERVE_AUTOSCALE_WINDOW_S": "6.0",
+    }
+    saved_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    fields = {
+        "metrics_report_interval_ms": 200,
+        "serve_autoscale_interval_s": 0.25,
+        "serve_autoscale_window_s": 6.0,
+    }
+    saved_cfg = {k: getattr(_config, k) for k in fields}
+    for k, v in fields.items():
+        setattr(_config, k, v)
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    serve_api._local.clear()  # no handles from an earlier cluster
+    yield ray_tpu, serve
+    try:
+        serve.shutdown()
+    except Exception:  # noqa: BLE001 - cluster already torn down
+        serve_api._local.clear()
+    ray_tpu.shutdown()
+    for k, v in saved_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    for k, v in saved_cfg.items():
+        setattr(_config, k, v)
+
+
+def test_closed_loop_scales_up_under_load_and_drains_back(elastic_cluster):
+    """Load -> metric-derived target rises -> fleet grows; silence ->
+    surplus replicas retire through the DRAIN protocol (zero failed
+    requests end to end); reconcile never stalls on the scaling path."""
+    ray, serve = elastic_cluster
+    from ray_tpu.core.config import _config
+
+    @serve.deployment(
+        name="Elastic", max_ongoing_requests=4,
+        autoscaling_config=dict(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+            upscale_delay_s=0.5, downscale_delay_s=1.5,
+        ),
+    )
+    class Elastic:
+        def __call__(self, x):
+            time.sleep(0.25)
+            return x * 2
+
+    handle = serve.run(Elastic.bind())
+    results, errors = [], []
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                results.append(ray.get(handle.remote(7), timeout=30))
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                errors.append(e)
+
+    threads = [threading.Thread(target=pump, daemon=True) for _ in range(8)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 45
+        peak_target = peak_running = 1
+        while time.time() < deadline:
+            st = serve.status()["Elastic"]
+            peak_target = max(peak_target, st["target"])
+            peak_running = max(peak_running, st["running"])
+            if peak_target >= 2 and peak_running >= 2:
+                break
+            time.sleep(0.3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert peak_target >= 2, f"target never rose under load: {serve.status()}"
+    assert peak_running >= 2, "the fleet never actually grew"
+    assert not errors, f"scaling must not fail requests: {errors[:3]}"
+    assert results and all(r == 14 for r in results)
+
+    # silence: the engine walks the target back to min and the surplus
+    # replicas retire through the drain coordinator
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = serve.status()["Elastic"]
+        if st["target"] == 1 and st["running"] == 1 and not st["draining"]:
+            break
+        time.sleep(0.5)
+    st = serve.status()
+    assert st["Elastic"]["target"] == 1, st
+    assert st["Elastic"]["running"] == 1, st
+    ctl = st["_control"]
+    assert ctl["autoscale_events"] >= 2       # at least one up + one down
+    assert ctl["drained"] >= 1                # graceful retire, not a kill
+    assert ctl["reconcile_ticks"] > 0 and ctl["autoscale_ticks"] > 0
+    # the old _autoscale blocked reconcile up to 10s on a metrics fan-out;
+    # the engine thread must keep every reconcile tick under the SLO
+    assert ctl["max_reconcile_stall_s"] < _config.serve_reconcile_max_stall_s
+    serve.delete("Elastic")
+
+
+def test_scale_to_zero_cold_wake_records_cold_start(elastic_cluster):
+    ray, serve = elastic_cluster
+
+    @serve.deployment(
+        name="Napper",
+        autoscaling_config=dict(
+            min_replicas=0, max_replicas=2, target_ongoing_requests=2.0,
+            upscale_delay_s=0.3, downscale_delay_s=1.0,
+        ),
+    )
+    def napper(x):
+        return {"v": x + 1}
+
+    handle = serve.run(napper)
+    # min_replicas=0 deploys an EMPTY fleet: the first request is the wake
+    assert serve.status()["Napper"]["running"] == 0
+    assert ray.get(handle.remote(41), timeout=60) == {"v": 42}
+    assert serve.status()["Napper"]["running"] >= 1
+
+    # this driver's router measured the queued-against-empty-fleet time
+    from ray_tpu.util import metrics as m
+
+    cold = next((s for s in m.get_registry().collect()
+                 if s["name"] == "serve_cold_start_ms"), None)
+    assert cold is not None, "cold wake must observe serve_cold_start_ms"
+    assert any(sum(v) > 0 for v in cold["points"].values()
+               if isinstance(v, list))
+
+    # silence returns it all the way to zero...
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        st = serve.status()["Napper"]
+        if st["target"] == 0 and st["running"] == 0:
+            break
+        time.sleep(0.3)
+    st = serve.status()["Napper"]
+    assert st["target"] == 0 and st["running"] == 0, st
+    # ...and it wakes again on the next request
+    assert ray.get(handle.remote(1), timeout=60) == {"v": 2}
+    serve.delete("Napper")
+
+
+def test_controller_sigkill_mid_scale_restores_decided_target(elastic_cluster):
+    """The engine checkpoints a decided target BEFORE actuating it, so a
+    controller SIGKILLed mid-scale-up restores the decision (not the
+    deploy-time floor) and resumes converging. The durability proof is the
+    KV itself, read pre-kill: racing the restarted engine's first tick is
+    unsound because a FRESH policy (no cooldown stamps) may legally take
+    one immediate downscale step against the now-idle fleet."""
+    import json
+
+    ray, serve = elastic_cluster
+    from ray_tpu.api import _global_worker
+    from ray_tpu.serve import api as serve_api
+
+    @serve.deployment(
+        name="Durable", max_ongoing_requests=4,
+        autoscaling_config=dict(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+            upscale_delay_s=0.3, downscale_delay_s=3600.0,  # freeze downs
+        ),
+    )
+    class Durable:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x + 1
+
+    handle = serve.run(Durable.bind())
+    stop = threading.Event()
+    errors = []
+
+    def pump():
+        while not stop.is_set():
+            try:
+                ray.get(handle.remote(1), timeout=30)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    # 6 pumps against target_ongoing=1.0: the step-up decision jumps to
+    # ceil(ongoing/target) — drive until the decision hits max (3) so the
+    # post-restart floor contrast below is unambiguous
+    threads = [threading.Thread(target=pump, daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    decided = 1
+    try:
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            decided = serve.status()["Durable"]["target"]
+            if decided >= 3:
+                break
+            time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert decided == 3, "load never drove the target to max"
+    assert not errors, errors[:3]
+
+    # the decision is already durable: checkpoint precedes apply, so the
+    # scale_targets KV records it the instant status() can show it
+    core = _global_worker().backend.core
+
+    def kv_get(ns, key):
+        async def call():
+            return await core.gcs.call("kv_get", ns=ns, key=key, timeout=30)
+
+        return core.io.run(call(), timeout=60)
+
+    blob = kv_get("serve", "scale_targets")
+    ckpt = json.loads(blob.decode() if isinstance(blob, bytes) else blob)
+    assert ckpt.get("Durable") == decided, f"checkpoint missing: {ckpt}"
+
+    # SIGKILL the controller mid-convergence (its owned replicas die too)
+    controller = ray.get_actor(serve_api.CONTROLLER_NAME)
+    ray.kill(controller)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            ray.get_actor(serve_api.CONTROLLER_NAME)
+            time.sleep(0.25)
+        except Exception:  # noqa: BLE001 - controller gone
+            break
+
+    serve_api._local.clear()
+    serve.start()
+    # with zero load, a controller restoring only the deployment checkpoint
+    # sits at the deploy floor (min_replicas=1) forever — reconverging to
+    # >= 2 replicas is reachable ONLY through the restored scale_targets
+    # overlay (the fresh policy may dip 3 -> 2 once, then downscale is
+    # frozen for 3600 s, so >= 2 is the stable restored state)
+    st = None
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            st = serve.status()["Durable"]
+        except Exception:  # noqa: BLE001 - controller still booting
+            time.sleep(0.5)
+            continue
+        if st["target"] >= 2 and st["running"] >= 2:
+            break
+        time.sleep(0.5)
+    assert st is not None, "restarted controller never answered status()"
+    assert st["target"] >= 2 and st["running"] >= 2, (
+        f"fleet fell back to the deploy floor: {st}"
+    )
+    # the restored fleet serves traffic — retried: the first request can
+    # still race a stale routing entry from the torn-down fleet (router
+    # reports it dead, replacement lands next reconcile tick)
+    h2 = serve.get_handle("Durable")
+    got = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            got = ray.get(h2.remote(41), timeout=15)
+            break
+        except Exception:  # noqa: BLE001 - stale-entry race, retry
+            time.sleep(0.5)
+    assert got == 42, "restored fleet never answered"
+    serve.delete("Durable")
+
+
+def test_router_quorum_ejects_replica_and_reconcile_replaces_it(
+        elastic_cluster):
+    """One router's open breaker is local evidence (recorded only); a
+    quorum of DISTINCT routers ejects the replica fleet-wide and the
+    reconcile ticker starts a replacement."""
+    ray, serve = elastic_cluster
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.serve.controller import _replica_key
+
+    @serve.deployment(name="Quorum", num_replicas=2)
+    def q(x):
+        return x + 5
+
+    handle = serve.run(q)
+    assert ray.get(handle.remote(1), timeout=60) == 6
+    controller = serve_api._local["controller"]
+    table = ray.get(controller.routing_table.remote(-1), timeout=30)
+    actors = table["deployments"]["Quorum"]
+    assert len(actors) == 2
+    victim = _replica_key(actors[0])
+
+    # one router reporting twice is still ONE reporter: no ejection
+    for _ in range(2):
+        ray.get(controller.report_replica_state.remote(
+            "Quorum", victim, "open", "router-a"), timeout=30)
+    st = serve.status()["Quorum"]
+    assert st["running"] == 2
+    assert st["circuit"].get(victim.hex()) == "open"
+
+    # a second distinct router completes the quorum: ejected + drained
+    ray.get(controller.report_replica_state.remote(
+        "Quorum", victim, "open", "router-b"), timeout=30)
+    replaced = False
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        t2 = ray.get(controller.routing_table.remote(-1), timeout=30)
+        keys = {_replica_key(a) for a in t2["deployments"]["Quorum"]}
+        if victim not in keys and len(keys) == 2:
+            replaced = True
+            break
+        time.sleep(0.3)
+    assert replaced, "ejected replica was not replaced by a fresh one"
+    assert ray.get(handle.remote(2), timeout=60) == 7
+    assert serve.status()["_control"]["drained"] >= 1
+    serve.delete("Quorum")
+
+
+# ------------------------------------------------- chaos: SIGKILL mid-drain
+@pytest.fixture
+def chaos_cluster():
+    import ray_tpu
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.testing import chaos
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu import serve
+
+    serve_api._local.clear()  # no handles from an earlier cluster
+    yield ray_tpu, serve
+    chaos.deactivate()
+    try:
+        serve.shutdown()
+    except Exception:  # noqa: BLE001 - cluster already torn down
+        serve_api._local.clear()
+    ray_tpu.shutdown()
+
+
+def test_chaos_sigkill_draining_replica_fails_over_typed(chaos_cluster):
+    """A replica SIGKILLed the moment it enters DRAINING (before its
+    in-flight requests finish) must resolve those requests through the
+    router failover plane — retried to a survivor or a TYPED error, never
+    an untyped crash or a hang. The plan must show the ``replica.drain``
+    fire happened in the controller process."""
+    ray, serve = chaos_cluster
+    import ray_tpu.exceptions as rexc
+    from ray_tpu.testing import chaos
+
+    plan = chaos.plan(seed=18).kill_draining_replica(match="Shrink")
+    # push to the ALREADY-running daemons so the controller (spawned by a
+    # raylet after this) inherits the plan env
+    assert chaos.activate(plan) >= 1
+
+    @serve.deployment(name="Shrink", num_replicas=2, max_ongoing_requests=8)
+    class Shrink:
+        def __call__(self, x):
+            time.sleep(1.0)
+            return x * 3
+
+    handle = serve.run(Shrink.bind())
+    # warm both replicas so the routing table is fully populated
+    assert sorted(ray.get([handle.remote(i) for i in range(2)],
+                          timeout=90)) == [0, 3]
+
+    results, errors = {}, []
+
+    def call(i):
+        try:
+            results[i] = ray.get(handle.remote(i), timeout=60)
+        except Exception as e:  # noqa: BLE001 - asserted typed below
+            errors.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # let the batch dispatch across BOTH replicas
+    # shrink to one replica: the surplus replica enters DRAINING with
+    # requests in flight and the chaos plan SIGKILLs it right there
+    serve.run(Shrink.options(num_replicas=1).bind())
+    for t in threads:
+        t.join(timeout=120)
+
+    assert len(results) + len(errors) == 6, "a request hung"
+    for e in errors:
+        assert isinstance(e, rexc.RayTpuError), f"untyped failure: {e!r}"
+    for i, v in results.items():
+        assert v == i * 3, f"failover corrupted request {i}: {v}"
+    # the kill really happened, mid-drain, in the controller (not here)
+    events = [e for e in plan.events() if e["point"] == "replica.drain"]
+    assert events, "replica.drain never fired"
+    assert events[0]["action"] == "kill"
+    assert events[0]["pid"] != os.getpid()
+    chaos.deactivate()
+
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        if serve.status()["Shrink"]["running"] == 1:
+            break
+        time.sleep(0.3)
+    assert serve.status()["Shrink"]["running"] == 1
+    serve.delete("Shrink")
+
+
+# ------------------------------------------------- cluster: elastic node tier
+@pytest.fixture
+def tier_cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=c.address)
+    yield ray_tpu, c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_node_scale_down_pre_spills_primaries_byte_identical(tier_cluster):
+    """Demand launches a node; idleness retires it THROUGH the drain path
+    (``drain_node`` pre-spills every in-memory primary), so an object whose
+    only copy lived on the leaving node is still readable byte-identical
+    afterwards via spill adoption. The tier's durable ownership record
+    tracks the fleet both ways."""
+    ray, c = tier_cluster
+    from ray_tpu.api import _global_worker
+    from ray_tpu.autoscaler import LocalNodeProvider
+    from ray_tpu.autoscaling import NodeTier
+
+    core = _global_worker().backend.core
+
+    def gcs_call(method, **k):
+        async def call():
+            return await core.gcs.call(method, timeout=30, **k)
+
+        return core.io.run(call(), timeout=60)
+
+    blob = b"elasticity" * 131072  # ~1.3 MB: a real shm primary
+
+    provider = LocalNodeProvider(c.address, c.session)
+    tier = NodeTier(
+        provider, gcs_call, min_nodes=0, max_nodes=1,
+        upscale_delay_s=0.3, idle_timeout_s=2.0, poll_interval_s=0.3,
+        node_resources={"CPU": 2}, kv_call=gcs_call,
+    )
+    tier.start()
+    try:
+        # the 1-CPU head can't fit CPU:2 -> queued demand grows the fleet
+        @ray.remote(num_cpus=2)
+        def make_blob():
+            return b"elasticity" * 131072
+
+        ref = make_blob.remote()
+        ready, _ = ray.wait([ref], timeout=120)
+        assert ready, "demand-driven scale-up never ran the task"
+        nodes = provider.non_terminated_nodes()
+        assert len(nodes) == 1 and tier.scale_ups >= 1
+        # ownership record is durable while the node is up
+        assert NodeTier.restore_owned(gcs_call) == sorted(nodes)
+
+        # idle -> graceful drain -> terminate (do NOT touch ref before:
+        # its only in-memory copy must be on the node that leaves)
+        deadline = time.time() + 60
+        while provider.non_terminated_nodes() and time.time() < deadline:
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes() == []
+        assert tier.scale_downs >= 1
+        assert any("scale-down" in e for e in tier.events)
+
+        assert ray.get(ref, timeout=60) == blob
+        assert NodeTier.restore_owned(gcs_call) == []
+    finally:
+        tier.stop()
+        provider.shutdown()
+
+
+# --------------------------------------- regression: idle-owner pin renewal
+@pytest.fixture
+def pin_cluster():
+    import ray_tpu
+    from ray_tpu.core.config import _config
+
+    env = {
+        "RAY_TPU_OBJECT_PIN_TTL_S": "1.0",
+        "RAY_TPU_OBJECT_PIN_RENEW_INTERVAL_S": "0.25",
+    }
+    saved_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    saved_cfg = (_config.object_pin_ttl_s, _config.object_pin_renew_interval_s)
+    _config.object_pin_ttl_s = 1.0
+    _config.object_pin_renew_interval_s = 0.25
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    for k, v in saved_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    _config.object_pin_ttl_s, _config.object_pin_renew_interval_s = saved_cfg
+
+
+def test_idle_owner_pin_lease_outlives_many_ttls(pin_cluster):
+    """Renewals from a COMPLETELY idle owner must keep a primary's pin
+    lease alive. They used to ride the batched owner-metadata queue, which
+    only flushes when other traffic wakes it and dropped its payload
+    silently on a send error — an idle driver's primary could quietly
+    become evictable. The dedicated renewal send (with its own retry)
+    closes that: after several full TTLs of doing NOTHING, the object is
+    still pinned in the raylet."""
+    ray = pin_cluster
+    # big enough to bypass the inline path and land in the shm store as a
+    # pinned PRIMARY (> max_direct_call_object_size)
+    payload = b"pinned" * 50_000
+    ray.put(b"warmup")  # ensure the store/meta planes are up
+    ref = ray.put(payload)
+    time.sleep(3.5)  # idle across >3 TTL windows; renewals are the only RPC
+
+    from ray_tpu.api import _global_worker
+
+    core = _global_worker().backend.core
+
+    async def stats():
+        return await core.raylet.call("object_stats", timeout=30)
+
+    st = core.io.run(stats(), timeout=60)
+    assert st["pinned_bytes"] > 0, (
+        f"pin lease expired on an idle owner: {st}"
+    )
+    assert ray.get(ref, timeout=30) == payload
